@@ -1,0 +1,19 @@
+"""photon-tpu: a TPU-native (JAX/XLA) framework with the capabilities of
+LinkedIn Photon-ML (GLMs + GAME/GLMix mixed-effect models).
+
+Layer map (mirrors reference photon-lib/photon-api/photon-client, see SURVEY.md):
+
+- ``photon_tpu.ops``        pointwise losses, normalization, GLM objectives (L0/L1)
+- ``photon_tpu.optimize``   L-BFGS / OWLQN / TRON as jit-compiled while-loops (L2/L3)
+- ``photon_tpu.models``     Coefficients, GLM model classes, GAME models (L6)
+- ``photon_tpu.data``       datasets, LIBSVM/Avro ingest, index maps, stats, validators (L4)
+- ``photon_tpu.parallel``   mesh / sharding helpers, distributed training programs
+- ``photon_tpu.game``       GAME datasets, coordinates, coordinate descent, estimator (L5/L7)
+- ``photon_tpu.evaluation`` evaluators incl. grouped MultiEvaluators (L8)
+- ``photon_tpu.hyperparameter``  GP Bayesian tuning + random search (L8b)
+- ``photon_tpu.io``         model persistence (Avro parity)
+- ``photon_tpu.diagnostics`` metrics / model diagnostics / reports (L10)
+- ``photon_tpu.cli``        drivers (L9)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from photon_tpu.data.dataset import DataSet, pad_batch, to_device_batch  # noqa: F401
+from photon_tpu.data.index_map import DefaultIndexMap, IndexMap  # noqa: F401
+from photon_tpu.data.libsvm import read_libsvm  # noqa: F401
+from photon_tpu.data.stats import BasicStatisticalSummary  # noqa: F401

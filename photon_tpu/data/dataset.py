@@ -1,0 +1,159 @@
+"""Host-side dataset containers and device batching.
+
+TPU-native replacement for the reference's RDD-based data layer
+(photon-lib data/DataSet.scala, photon-api data/FixedEffectDataSet.scala:31):
+instead of ``RDD[(UniqueSampleId, LabeledPoint)]`` partitions, a dataset is a
+set of aligned numpy arrays (CSR features + label/offset/weight columns)
+that is padded to static shapes and transferred once to device. Sample
+identity is the array position — which makes the reference's score-join
+machinery (full-outer-joins on UniqueSampleId,
+data/scoring/CoordinateDataScores.scala:53-62) a vectorized add/subtract on
+aligned score arrays.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.types import Array, LabeledBatch
+
+
+@dataclasses.dataclass
+class DataSet:
+    """A labeled dataset in host memory, features in CSR form.
+
+    ``indptr/indices/values`` follow scipy CSR conventions; ``num_features``
+    is the (global or shard) feature dimension, including the intercept
+    column if one was added at ingest.
+    """
+
+    indptr: np.ndarray  # [N+1] int64
+    indices: np.ndarray  # [nnz] int32
+    values: np.ndarray  # [nnz] float
+    labels: np.ndarray  # [N]
+    offsets: np.ndarray  # [N]
+    weights: np.ndarray  # [N]
+    num_features: int
+
+    def __post_init__(self):
+        n = self.num_samples
+        assert self.labels.shape == (n,)
+        assert self.offsets.shape == (n,)
+        assert self.weights.shape == (n,)
+
+    @property
+    def num_samples(self) -> int:
+        return self.indptr.shape[0] - 1
+
+    def to_dense(self, dtype=np.float32) -> np.ndarray:
+        out = np.zeros((self.num_samples, self.num_features), dtype=dtype)
+        rows = np.repeat(np.arange(self.num_samples), np.diff(self.indptr))
+        out[rows, self.indices] = self.values
+        return out
+
+    def row(self, i: int) -> tuple[np.ndarray, np.ndarray]:
+        lo, hi = self.indptr[i], self.indptr[i + 1]
+        return self.indices[lo:hi], self.values[lo:hi]
+
+    def take(self, idx: np.ndarray) -> "DataSet":
+        """Row-subset (used by down-sampling / train-fraction diagnostics)."""
+        idx = np.asarray(idx)
+        counts = self.indptr[idx + 1] - self.indptr[idx]
+        indptr = np.zeros(len(idx) + 1, dtype=np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        # One fancy-index gather: positions of all kept nonzeros.
+        starts = np.repeat(self.indptr[idx], counts)
+        within = np.arange(int(indptr[-1])) - np.repeat(indptr[:-1], counts)
+        gather = starts + within
+        indices = self.indices[gather]
+        values = self.values[gather]
+        return DataSet(
+            indptr=indptr,
+            indices=indices,
+            values=values,
+            labels=self.labels[idx],
+            offsets=self.offsets[idx],
+            weights=self.weights[idx],
+            num_features=self.num_features,
+        )
+
+    def add_offsets(self, scores: np.ndarray) -> "DataSet":
+        """Positionally aligned offset update (reference
+        DataSet.addScoresToOffsets — a shuffle join there, an add here)."""
+        return dataclasses.replace(self, offsets=self.offsets + scores)
+
+    @staticmethod
+    def from_dense(
+        x: np.ndarray,
+        labels: np.ndarray,
+        offsets: np.ndarray | None = None,
+        weights: np.ndarray | None = None,
+    ) -> "DataSet":
+        n, d = x.shape
+        mask = x != 0
+        counts = mask.sum(axis=1)
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        indices = np.nonzero(mask)[1].astype(np.int32)
+        values = x[mask].astype(np.float64)
+        return DataSet(
+            indptr=indptr,
+            indices=indices,
+            values=values,
+            labels=np.asarray(labels, dtype=np.float64),
+            offsets=np.zeros(n) if offsets is None else np.asarray(offsets),
+            weights=np.ones(n) if weights is None else np.asarray(weights),
+            num_features=d,
+        )
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def pad_batch(batch: LabeledBatch, target_rows: int) -> LabeledBatch:
+    """Pad a batch with zero-weight rows up to ``target_rows`` (static shapes
+    for XLA; padding rows vanish from every weighted reduction)."""
+    n = batch.features.shape[0]
+    if n == target_rows:
+        return batch
+    pad = target_rows - n
+    return LabeledBatch(
+        features=jnp.pad(batch.features, ((0, pad), (0, 0))),
+        labels=jnp.pad(batch.labels, (0, pad)),
+        offsets=jnp.pad(batch.offsets, (0, pad)),
+        weights=jnp.pad(batch.weights, (0, pad)),
+    )
+
+
+def to_device_batch(
+    data: DataSet,
+    dtype=jnp.float32,
+    pad_to_multiple: int = 8,
+) -> LabeledBatch:
+    """Densify + pad to a static row count and move to device.
+
+    The dense [N, D] layout keeps the per-iteration X·w and Xᵀr on the MXU;
+    row padding rounds N up so re-jits don't proliferate across epochs.
+    """
+    dense = data.to_dense(dtype=np.float32 if dtype == jnp.bfloat16 else dtype)
+    target = _round_up(max(data.num_samples, 1), pad_to_multiple)
+    batch = LabeledBatch(
+        features=jnp.asarray(dense, dtype=dtype),
+        labels=jnp.asarray(data.labels, dtype=dtype),
+        offsets=jnp.asarray(data.offsets, dtype=dtype),
+        weights=jnp.asarray(data.weights, dtype=dtype),
+    )
+    return pad_batch(batch, target)
+
+
+def train_validation_split(
+    data: DataSet, validation_fraction: float, seed: int = 0
+) -> tuple[DataSet, DataSet]:
+    rng = np.random.default_rng(seed)
+    n = data.num_samples
+    perm = rng.permutation(n)
+    n_val = int(n * validation_fraction)
+    return data.take(np.sort(perm[n_val:])), data.take(np.sort(perm[:n_val]))

@@ -1,0 +1,77 @@
+"""Down-sampling strategies.
+
+Reference parity: photon-lib sampling/DownSampler.scala:45,
+DefaultDownSampler (uniform) and BinaryClassificationDownSampler
+(down-samples negatives only, re-weighting survivors by 1/rate,
+sampling/BinaryClassificationDownSampler.scala:32-68). The reference samples
+RDDs before the fixed-effect solve (DistributedOptimizationProblem
+.runWithSampling:145-160); here sampling happens on host before batching —
+the device program never sees dropped rows.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_tpu.data.dataset import DataSet
+from photon_tpu.ops.losses import POSITIVE_RESPONSE_THRESHOLD
+
+
+class DownSampler:
+    def downsample(self, data: DataSet, seed: int = 0) -> DataSet:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class DefaultDownSampler(DownSampler):
+    """Uniform row sampling without weight correction (reference
+    DefaultDownSampler — weights are intentionally left as-is there)."""
+
+    down_sampling_rate: float
+
+    def downsample(self, data: DataSet, seed: int = 0) -> DataSet:
+        rng = np.random.default_rng(seed)
+        keep = rng.uniform(size=data.num_samples) < self.down_sampling_rate
+        return data.take(np.nonzero(keep)[0])
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryClassificationDownSampler(DownSampler):
+    """Keep all positives; sample negatives at ``rate`` and re-weight the
+    surviving negatives by 1/rate so expected gradients are unchanged."""
+
+    down_sampling_rate: float
+
+    def downsample(self, data: DataSet, seed: int = 0) -> DataSet:
+        rng = np.random.default_rng(seed)
+        pos = data.labels > POSITIVE_RESPONSE_THRESHOLD
+        keep_neg = (~pos) & (rng.uniform(size=data.num_samples) < self.down_sampling_rate)
+        keep = pos | keep_neg
+        out = data.take(np.nonzero(keep)[0])
+        new_weights = out.weights.copy()
+        kept_neg = out.labels <= POSITIVE_RESPONSE_THRESHOLD
+        new_weights[kept_neg] /= self.down_sampling_rate
+        return dataclasses.replace(out, weights=new_weights)
+
+
+def build_down_sampler(is_classification: bool, rate: float) -> DownSampler | None:
+    """Factory used by optimization problems (reference
+    DownSampler.buildSampler dispatch). Rate outside (0, 1) → no sampling."""
+    if not (0.0 < rate < 1.0):
+        return None
+    if is_classification:
+        return BinaryClassificationDownSampler(rate)
+    return DefaultDownSampler(rate)
+
+
+def reservoir_sample(
+    items: np.ndarray, k: int, rng: np.random.Generator
+) -> np.ndarray:
+    """Index-array reservoir sample of size k (reference
+    RandomEffectDataSet.groupKeyedDataSetViaReservoirSampling:305)."""
+    n = len(items)
+    if n <= k:
+        return items
+    idx = rng.choice(n, size=k, replace=False)
+    return items[np.sort(idx)]

@@ -1,0 +1,9 @@
+from photon_tpu.models.coefficients import Coefficients  # noqa: F401
+from photon_tpu.models.glm import (  # noqa: F401
+    GeneralizedLinearModel,
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel,
+    model_for_task,
+)

@@ -1,0 +1,52 @@
+"""Model coefficients: means + optional variances.
+
+Reference parity: photon-lib model/Coefficients.scala:31 (means,
+variancesOption, computeScore, Summarizable).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.types import Array
+
+
+class Coefficients(NamedTuple):
+    """Dense coefficient vector with optional per-coefficient variances.
+
+    NamedTuple → automatically a JAX pytree, so Coefficients flow through
+    jit/vmap/pjit unchanged.
+    """
+
+    means: Array
+    variances: Array | None = None
+
+    @property
+    def num_features(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, features: Array) -> Array:
+        """x·w (reference Coefficients.computeScore)."""
+        return features @ self.means
+
+    def l2_norm(self) -> Array:
+        return jnp.linalg.norm(self.means)
+
+    @staticmethod
+    def zeros(dimension: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros((dimension,), dtype=dtype))
+
+    def summary(self) -> str:
+        m = np.asarray(self.means)
+        lines = [
+            f"Coefficients(dim={m.shape[-1]}, "
+            f"l2={float(np.linalg.norm(m)):.6g}, "
+            f"nnz={int(np.count_nonzero(m))}, "
+            f"max|w|={float(np.max(np.abs(m))) if m.size else 0.0:.6g})"
+        ]
+        if self.variances is not None:
+            v = np.asarray(self.variances)
+            lines.append(f"  variances: mean={float(v.mean()):.6g}")
+        return "\n".join(lines)

@@ -1,0 +1,93 @@
+"""Generalized linear model classes.
+
+Reference parity: photon-api supervised/model/GeneralizedLinearModel.scala:33-165
+(abstract computeMean :51), classification/LogisticRegressionModel.scala:51,
+regression/{LinearRegressionModel,PoissonRegressionModel}.scala,
+supervised/classification/SmoothedHingeLossLinearSVMModel.scala, and the GAME
+``DatumScoringModel`` trait (photon-lib model/).
+
+A model = Coefficients + a mean (inverse-link) function. Scores are raw
+margins; means apply the link. Classification models expose
+``predict_class(threshold)`` (reference BinaryClassifier trait).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.ops.losses import sigmoid
+from photon_tpu.types import Array, TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """Base GLM: margin scoring + task-specific mean."""
+
+    coefficients: Coefficients
+
+    task: TaskType = dataclasses.field(init=False, repr=False, default=None)
+
+    def compute_margin(self, features: Array, offsets: Array | None = None) -> Array:
+        z = self.coefficients.compute_score(features)
+        return z if offsets is None else z + offsets
+
+    def compute_mean(self, margins: Array) -> Array:
+        """Inverse link applied to margins; identity by default."""
+        return margins
+
+    def predict(self, features: Array, offsets: Array | None = None) -> Array:
+        return self.compute_mean(self.compute_margin(features, offsets))
+
+    def update_coefficients(self, coefficients: Coefficients):
+        return dataclasses.replace(self, coefficients=coefficients)
+
+    @property
+    def model_class_name(self) -> str:
+        return type(self).__name__
+
+
+@dataclasses.dataclass(frozen=True)
+class LogisticRegressionModel(GeneralizedLinearModel):
+    task = TaskType.LOGISTIC_REGRESSION
+
+    def compute_mean(self, margins: Array) -> Array:
+        return sigmoid(margins)
+
+    def predict_class(self, features: Array, threshold: float = 0.5) -> Array:
+        return (self.predict(features) > threshold).astype(jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearRegressionModel(GeneralizedLinearModel):
+    task = TaskType.LINEAR_REGRESSION
+
+
+@dataclasses.dataclass(frozen=True)
+class PoissonRegressionModel(GeneralizedLinearModel):
+    task = TaskType.POISSON_REGRESSION
+
+    def compute_mean(self, margins: Array) -> Array:
+        return jnp.exp(margins)
+
+
+@dataclasses.dataclass(frozen=True)
+class SmoothedHingeLossLinearSVMModel(GeneralizedLinearModel):
+    task = TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
+
+    def predict_class(self, features: Array, threshold: float = 0.0) -> Array:
+        return (self.compute_margin(features) > threshold).astype(jnp.float32)
+
+
+_TASK_MODEL = {
+    TaskType.LOGISTIC_REGRESSION: LogisticRegressionModel,
+    TaskType.LINEAR_REGRESSION: LinearRegressionModel,
+    TaskType.POISSON_REGRESSION: PoissonRegressionModel,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SmoothedHingeLossLinearSVMModel,
+}
+
+
+def model_for_task(task: TaskType, coefficients: Coefficients) -> GeneralizedLinearModel:
+    """Task → model-constructor dispatch (reference ModelTraining.scala:127-160)."""
+    return _TASK_MODEL[task](coefficients=coefficients)

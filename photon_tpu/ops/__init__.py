@@ -1,0 +1,11 @@
+from photon_tpu.ops import losses, normalization, objective  # noqa: F401
+from photon_tpu.ops.losses import (  # noqa: F401
+    LogisticLoss,
+    PointwiseLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+    loss_for_task,
+)
+from photon_tpu.ops.normalization import NormalizationContext  # noqa: F401
+from photon_tpu.ops.objective import GLMObjective  # noqa: F401

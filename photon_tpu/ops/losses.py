@@ -1,0 +1,148 @@
+"""Pointwise GLM losses: scalar math per datum, vectorized over batches.
+
+Each loss provides ``loss(z, y)``, ``d1(z, y)`` (dl/dz) and ``d2(z, y)``
+(d²l/dz²) as pure jnp functions of the margin ``z = x·w + offset`` and label
+``y``. These are the TPU-native counterparts of the reference's
+``PointwiseLossFunction.lossAndDzLoss`` / ``DzzLoss``
+(reference: photon-lib function/glm/PointwiseLossFunction.scala:54,
+photon-api function/glm/{Logistic,Squared,Poisson}LossFunction.scala,
+function/svm/SmoothedHingeLossFunction.scala).
+
+Conventions (matching the reference):
+- classification labels may be {0,1} or {-1,1}; "positive" means y > 0.5
+  (reference MathConst.POSITIVE_RESPONSE_THRESHOLD).
+- all functions are elementwise and jit/vmap/grad-safe (no Python branching
+  on traced values).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+
+from photon_tpu.types import Array, TaskType
+
+POSITIVE_RESPONSE_THRESHOLD = 0.5
+
+
+def log1p_exp(z: Array) -> Array:
+    """Numerically stable log(1 + exp(z)) (reference MathUtils.log1pExp)."""
+    return jnp.logaddexp(0.0, z)
+
+
+def sigmoid(z: Array) -> Array:
+    # Expressed via exp of a non-positive argument only, so neither tail
+    # overflows (this backend's tanh/logistic NaN out for |z| ≳ 100).
+    e = jnp.exp(-jnp.abs(z))
+    return jnp.where(z >= 0, 1.0 / (1.0 + e), e / (1.0 + e))
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss l(z, y) with first and second margin-derivatives."""
+
+    name: str
+    loss: Callable[[Array, Array], Array]
+    d1: Callable[[Array, Array], Array]
+    d2: Callable[[Array, Array], Array]
+    # Whether d2 is everywhere defined/useful (smoothed hinge is only
+    # piecewise-C2; the reference restricts it to DiffFunction, no TRON).
+    twice_diff: bool = True
+
+    def loss_and_d1(self, z: Array, y: Array) -> tuple[Array, Array]:
+        return self.loss(z, y), self.d1(z, y)
+
+
+def _logistic_loss(z: Array, y: Array) -> Array:
+    pos = y > POSITIVE_RESPONSE_THRESHOLD
+    return jnp.where(pos, log1p_exp(-z), log1p_exp(z))
+
+
+def _logistic_d1(z: Array, y: Array) -> Array:
+    pos = y > POSITIVE_RESPONSE_THRESHOLD
+    return jnp.where(pos, -sigmoid(-z), sigmoid(z))
+
+
+def _logistic_d2(z: Array, y: Array) -> Array:
+    s = sigmoid(z)
+    return s * (1.0 - s)
+
+
+LogisticLoss = PointwiseLoss(
+    name="logistic", loss=_logistic_loss, d1=_logistic_d1, d2=_logistic_d2
+)
+
+
+def _squared_loss(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+SquaredLoss = PointwiseLoss(
+    name="squared",
+    loss=_squared_loss,
+    d1=lambda z, y: z - y,
+    d2=lambda z, y: jnp.ones_like(z),
+)
+
+
+def _poisson_loss(z: Array, y: Array) -> Array:
+    # l(z, y) = exp(z) - y*z  (negative Poisson log-likelihood up to const)
+    return jnp.exp(z) - y * z
+
+
+PoissonLoss = PointwiseLoss(
+    name="poisson",
+    loss=_poisson_loss,
+    d1=lambda z, y: jnp.exp(z) - y,
+    d2=lambda z, y: jnp.exp(z),
+)
+
+
+def _hinge_t(z: Array, y: Array) -> Array:
+    # Signed margin t = y_signed * z with y_signed in {-1, +1}.
+    y_signed = jnp.where(y > POSITIVE_RESPONSE_THRESHOLD, 1.0, -1.0)
+    return y_signed * z, y_signed
+
+
+def _smoothed_hinge_loss(z: Array, y: Array) -> Array:
+    # Rennie's smoothed hinge (reference function/svm/SmoothedHingeLossFunction.scala):
+    #   l(t) = 0.5 - t        if t <= 0
+    #          0.5*(1 - t)^2  if 0 < t < 1
+    #          0              if t >= 1
+    t, _ = _hinge_t(z, y)
+    quad = 0.5 * jnp.square(1.0 - t)
+    return jnp.where(t <= 0.0, 0.5 - t, jnp.where(t < 1.0, quad, 0.0))
+
+
+def _smoothed_hinge_d1(z: Array, y: Array) -> Array:
+    t, y_signed = _hinge_t(z, y)
+    dt = jnp.where(t <= 0.0, -1.0, jnp.where(t < 1.0, t - 1.0, 0.0))
+    return dt * y_signed
+
+
+def _smoothed_hinge_d2(z: Array, y: Array) -> Array:
+    t, _ = _hinge_t(z, y)
+    return jnp.where((t > 0.0) & (t < 1.0), 1.0, 0.0)
+
+
+SmoothedHingeLoss = PointwiseLoss(
+    name="smoothed_hinge",
+    loss=_smoothed_hinge_loss,
+    d1=_smoothed_hinge_d1,
+    d2=_smoothed_hinge_d2,
+    twice_diff=False,
+)
+
+_TASK_LOSS = {
+    TaskType.LOGISTIC_REGRESSION: LogisticLoss,
+    TaskType.LINEAR_REGRESSION: SquaredLoss,
+    TaskType.POISSON_REGRESSION: PoissonLoss,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SmoothedHingeLoss,
+}
+
+
+def loss_for_task(task: TaskType) -> PointwiseLoss:
+    """Task → loss dispatch (reference ObjectiveFunctionHelper / GLMLossFunction)."""
+    return _TASK_LOSS[task]

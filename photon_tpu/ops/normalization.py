@@ -1,0 +1,143 @@
+"""Feature normalization as an affine transform kept out of the data path.
+
+TPU-native take on the reference's ``NormalizationContext``
+(photon-lib normalization/NormalizationContext.scala:39-108,
+NormalizationType.scala): instead of materializing transformed features
+``x' = (x - shift) .* factor``, the GLM objective folds normalization into
+*effective coefficients* ``w .* factor`` plus a scalar margin shift
+``-(w .* factor)·shift``, so the raw device arrays are streamed untouched —
+the same sparsity-preserving margin algebra the reference uses
+(ValueAndGradientAggregator.scala:36-80), which on dense TPU tiles costs one
+elementwise multiply + one dot.
+
+Conventions (identical to the reference):
+- the intercept column, if present, has factor 1 and shift 0;
+- shifts require an intercept;
+- model↔transformed-space coefficient conversions keep the margin invariant:
+  ``w = w' .* factor``, ``b = b' − (w' .* factor)·shift``.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.types import Array, NormalizationType
+
+
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """Affine feature transform ``x' = (x - shift) .* factor``.
+
+    ``factors``/``shifts`` are length-D vectors or None (identity).
+    ``intercept_index`` is the column holding the constant-1 intercept.
+    """
+
+    factors: Array | None = None
+    shifts: Array | None = None
+    intercept_index: int | None = None
+
+    def __post_init__(self):
+        if self.shifts is not None and self.intercept_index is None:
+            raise ValueError("Shift without intercept is illegal.")
+        if (
+            self.factors is not None
+            and self.shifts is not None
+            and self.factors.shape != self.shifts.shape
+        ):
+            raise ValueError("Factors and shifts must have the same size.")
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    # --- objective-side algebra ------------------------------------------
+
+    def effective_coefficients(self, coef: Array) -> Array:
+        """``w .* factor`` — margins on raw features use these."""
+        if self.factors is None:
+            return coef
+        return coef * self.factors
+
+    def margin_shift(self, coef: Array) -> Array:
+        """Scalar added to every margin: ``-(w .* factor)·shift``."""
+        if self.shifts is None:
+            return jnp.zeros((), dtype=coef.dtype)
+        return -jnp.dot(self.effective_coefficients(coef), self.shifts)
+
+    # --- coefficient-space conversions -----------------------------------
+
+    def model_to_original_space(self, coef: Array) -> Array:
+        """Transformed-space coefficients → original space.
+
+        ``w = w' .* factor``; all shifts fold into the intercept:
+        ``b -= w·shift`` (reference NormalizationContext.modelToOriginalSpace).
+        """
+        out = coef if self.factors is None else coef * self.factors
+        if self.shifts is not None:
+            out = out.at[self.intercept_index].add(-jnp.dot(out, self.shifts))
+        return out
+
+    def model_to_transformed_space(self, coef: Array) -> Array:
+        """Original-space coefficients → transformed space (inverse of above)."""
+        out = coef
+        if self.shifts is not None:
+            out = out.at[self.intercept_index].add(jnp.dot(out, self.shifts))
+        if self.factors is not None:
+            out = out / self.factors
+        return out
+
+    # --- construction ------------------------------------------------------
+
+    @staticmethod
+    def identity() -> "NormalizationContext":
+        return NormalizationContext()
+
+    @staticmethod
+    def build(
+        normalization_type: NormalizationType,
+        *,
+        mean: np.ndarray | None = None,
+        variance: np.ndarray | None = None,
+        max_magnitude: np.ndarray | None = None,
+        intercept_index: int | None = None,
+        dtype=jnp.float32,
+    ) -> "NormalizationContext":
+        """Build from feature statistics (reference NormalizationContext factory).
+
+        - SCALE_WITH_STANDARD_DEVIATION: factor = 1/std
+        - SCALE_WITH_MAX_MAGNITUDE: factor = 1/max|x|
+        - STANDARDIZATION: factor = 1/std, shift = mean (requires intercept)
+        Factors for zero-variance / zero-magnitude features fall back to 1;
+        the intercept keeps factor 1 / shift 0.
+        """
+        if normalization_type == NormalizationType.NONE:
+            return NormalizationContext.identity()
+
+        def _safe_inv(v: np.ndarray) -> np.ndarray:
+            return np.where(v > 0.0, 1.0 / np.maximum(v, 1e-300), 1.0)
+
+        factors = shifts = None
+        if normalization_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+            factors = _safe_inv(np.sqrt(np.asarray(variance, dtype=np.float64)))
+        elif normalization_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+            factors = _safe_inv(np.abs(np.asarray(max_magnitude, dtype=np.float64)))
+        elif normalization_type == NormalizationType.STANDARDIZATION:
+            if intercept_index is None:
+                raise ValueError("STANDARDIZATION requires an intercept.")
+            factors = _safe_inv(np.sqrt(np.asarray(variance, dtype=np.float64)))
+            shifts = np.asarray(mean, dtype=np.float64).copy()
+        else:
+            raise ValueError(f"Unknown normalization type {normalization_type}")
+
+        if intercept_index is not None:
+            factors[intercept_index] = 1.0
+            if shifts is not None:
+                shifts[intercept_index] = 0.0
+
+        return NormalizationContext(
+            factors=jnp.asarray(factors, dtype=dtype),
+            shifts=None if shifts is None else jnp.asarray(shifts, dtype=dtype),
+            intercept_index=intercept_index,
+        )

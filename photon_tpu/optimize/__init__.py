@@ -1,0 +1,8 @@
+from photon_tpu.optimize.common import (  # noqa: F401
+    ConvergenceReason,
+    OptimizeResult,
+    OptimizerConfig,
+)
+from photon_tpu.optimize.lbfgs import minimize_lbfgs  # noqa: F401
+from photon_tpu.optimize.owlqn import minimize_owlqn  # noqa: F401
+from photon_tpu.optimize.tron import minimize_tron  # noqa: F401

@@ -1,0 +1,147 @@
+"""Shared optimizer machinery: configs, results, convergence accounting.
+
+Reference parity: photon-lib optimization/Optimizer.scala (convergence logic
+:135-156 — absolute tolerances derived from the zero-coefficient state
+:67-70,181), OptimizerState.scala:35, OptimizationStatesTracker.scala:33-99,
+util/ConvergenceReason.scala:21-37, optimization/OptimizerConfig.scala.
+
+All optimizers here are *functions* compiled into a single XLA while-loop
+(no host round-trips per iteration), returning an ``OptimizeResult`` whose
+history arrays replace the reference's mutable ``OptimizationStatesTracker``.
+Because results are pytrees of fixed shape, the optimizers compose with
+``jax.vmap`` (batched per-entity random-effect solves) and ``pjit``
+(data-sharded fixed-effect solves) unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.types import Array
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Why the optimizer stopped (reference ConvergenceReason.scala)."""
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    OBJECTIVE_NOT_IMPROVING = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Optimizer hyperparameters.
+
+    Defaults mirror the reference: LBFGS maxIter=100 tol=1e-7 m=10
+    (LBFGS.scala:154-156); TRON maxIter=15 tol=1e-5 CG<=20
+    (TRON.scala:256-276).
+    """
+
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    num_corrections: int = 10
+    # Box constraints: (lower, upper) arrays broadcastable to the coefficient
+    # shape, or None. Reference constraintMap →
+    # OptimizationUtils.projectCoefficientsToSubspace.
+    lower_bounds: Array | None = None
+    upper_bounds: Array | None = None
+    # Line search
+    ls_max_iterations: int = 25
+    ls_c1: float = 1e-4
+    ls_c2: float = 0.9
+    # TRON specifics
+    max_cg_iterations: int = 20
+    cg_tolerance: float = 0.1
+
+    def tron_defaults(self) -> "OptimizerConfig":
+        return dataclasses.replace(self, max_iterations=15, tolerance=1e-5)
+
+
+class OptimizeResult(NamedTuple):
+    """Terminal optimizer state + per-iteration history (fixed shapes).
+
+    ``loss_history[i]`` / ``grad_norm_history[i]`` hold the state after
+    iteration i (index 0 = initial state); entries past ``iterations`` are
+    padded with the final value.
+    """
+
+    x: Array
+    value: Array
+    gradient: Array
+    iterations: Array  # int32 scalar
+    reason: Array  # int32 scalar, ConvergenceReason code
+    loss_history: Array  # [max_iterations + 1]
+    grad_norm_history: Array  # [max_iterations + 1]
+
+    @property
+    def converged(self) -> Array:
+        return self.reason != ConvergenceReason.NOT_CONVERGED
+
+    def summary(self) -> str:
+        it = int(self.iterations)
+        reason = ConvergenceReason(int(self.reason)).name
+        lines = [
+            f"Optimization finished: iterations={it} reason={reason} "
+            f"loss={float(self.value):.8g} |grad|={float(jnp.linalg.norm(self.gradient)):.4g}",
+            f"{'iter':>5} {'loss':>16} {'|grad|':>12}",
+        ]
+        lh = np.asarray(self.loss_history)
+        gh = np.asarray(self.grad_norm_history)
+        for i in range(min(it + 1, lh.shape[0])):
+            lines.append(f"{i:>5} {lh[i]:>16.8g} {gh[i]:>12.4g}")
+        return "\n".join(lines)
+
+
+def project_to_box(
+    x: Array, lower: Array | None, upper: Array | None
+) -> Array:
+    """Clamp coefficients into box constraints (reference
+    OptimizationUtils.projectCoefficientsToSubspace, applied after every
+    optimizer step, LBFGS.scala:72)."""
+    if lower is not None:
+        x = jnp.maximum(x, lower)
+    if upper is not None:
+        x = jnp.minimum(x, upper)
+    return x
+
+
+def convergence_check(
+    *,
+    it: Array,
+    value: Array,
+    prev_value: Array,
+    grad_norm: Array,
+    loss_abs_tol: Array,
+    grad_abs_tol: Array,
+    max_iterations: int,
+    step_failed: Array,
+) -> Array:
+    """Reference Optimizer.getConvergenceReason:135-156 as one expression.
+
+    Order matters: max-iter > not-improving > function-values > gradient.
+    Returns an int32 ConvergenceReason code (0 = keep going).
+    """
+    reason = jnp.where(
+        it >= max_iterations,
+        ConvergenceReason.MAX_ITERATIONS,
+        jnp.where(
+            step_failed,
+            ConvergenceReason.OBJECTIVE_NOT_IMPROVING,
+            jnp.where(
+                jnp.abs(value - prev_value) <= loss_abs_tol,
+                ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+                jnp.where(
+                    grad_norm <= grad_abs_tol,
+                    ConvergenceReason.GRADIENT_CONVERGED,
+                    ConvergenceReason.NOT_CONVERGED,
+                ),
+            ),
+        ),
+    )
+    return reason.astype(jnp.int32)

@@ -1,0 +1,73 @@
+"""Common type aliases and task enums.
+
+Reference parity: photon-lib Types.scala (UniqueSampleId, CoordinateId, REId,
+FeatureShardId) and TaskType.scala.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, NamedTuple
+
+import jax
+
+Array = jax.Array
+PyTree = Any
+
+
+class LabeledBatch(NamedTuple):
+    """A dense batch of labeled points — the device-side analogue of the
+    reference's ``RDD[LabeledPoint]`` (photon-lib data/LabeledPoint.scala:32).
+
+    features: [N, D] (optionally padded), labels/offsets/weights: [N].
+    Padding rows carry weight 0 so every reduction ignores them.
+    """
+
+    features: Array
+    labels: Array
+    offsets: Array
+    weights: Array
+
+    @property
+    def num_features(self) -> int:
+        return self.features.shape[-1]
+
+# Reference: photon-lib/.../Types.scala
+UniqueSampleId = int
+CoordinateId = str
+REType = str
+REId = str
+FeatureShardId = str
+
+
+class TaskType(enum.Enum):
+    """Training task, reference TaskType.scala."""
+
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+    @property
+    def is_classification(self) -> bool:
+        return self in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )
+
+
+class OptimizerType(enum.Enum):
+    """Reference OptimizerType.scala."""
+
+    LBFGS = "LBFGS"
+    OWLQN = "OWLQN"
+    LBFGSB = "LBFGSB"
+    TRON = "TRON"
+
+
+class NormalizationType(enum.Enum):
+    """Reference normalization/NormalizationType.scala."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"

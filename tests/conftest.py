@@ -1,0 +1,30 @@
+"""Test harness: single-host multi-device CPU mesh.
+
+The reference tests all distributed behavior through local-mode Spark
+(`local[*]`, SparkTestUtils.scala:61-77). The JAX analogue is an 8-device
+virtual CPU platform: `xla_force_host_platform_device_count=8` set before
+backend init, so sharded==unsharded numerics can be asserted without TPUs.
+
+Environment note: this image boots an `axon` TPU-relay backend from
+sitecustomize and force-selects it via jax.config — the env var
+JAX_PLATFORMS=cpu alone is NOT honored, and the relay admits one client at
+a time (a second process hangs in make_c_api_client). Tests therefore pin
+the platform through jax.config *before* any backend is initialized, which
+keeps pytest off the relay entirely.
+
+x64 is enabled so optimizer/loss tests can assert against closed forms at
+tight tolerances; production TPU runs use f32/bf16.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,85 @@
+"""Pointwise-loss unit tests vs closed forms and autodiff.
+
+Mirrors the reference's pure unit tier (photon-api src/test function/glm
+loss tests).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.ops.losses import (
+    LogisticLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+)
+
+ALL_LOSSES = [LogisticLoss, SquaredLoss, PoissonLoss, SmoothedHingeLoss]
+
+Z = jnp.linspace(-5.0, 5.0, 41)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+@pytest.mark.parametrize("label", [0.0, 1.0])
+def test_d1_matches_autodiff(loss, label):
+    g_auto = jax.vmap(jax.grad(lambda z: loss.loss(z, label)))(Z)
+    g_exact = loss.d1(Z, jnp.full_like(Z, label))
+    np.testing.assert_allclose(g_exact, g_auto, atol=1e-10)
+
+
+@pytest.mark.parametrize(
+    "loss", [l for l in ALL_LOSSES if l.twice_diff], ids=lambda l: l.name
+)
+@pytest.mark.parametrize("label", [0.0, 1.0])
+def test_d2_matches_autodiff(loss, label):
+    h_auto = jax.vmap(jax.grad(jax.grad(lambda z: loss.loss(z, label))))(Z)
+    h_exact = loss.d2(Z, jnp.full_like(Z, label))
+    np.testing.assert_allclose(h_exact, h_auto, atol=1e-10)
+
+
+def test_logistic_closed_form():
+    # l(z, y=1) = log(1 + e^-z); l(z, y=0) = log(1 + e^z)
+    np.testing.assert_allclose(
+        LogisticLoss.loss(Z, jnp.ones_like(Z)), np.log1p(np.exp(-np.asarray(Z)))
+    )
+    np.testing.assert_allclose(
+        LogisticLoss.loss(Z, jnp.zeros_like(Z)), np.log1p(np.exp(np.asarray(Z)))
+    )
+
+
+def test_logistic_stable_at_extremes():
+    big = jnp.array([-500.0, 500.0])
+    v = LogisticLoss.loss(big, jnp.array([1.0, 1.0]))
+    assert np.all(np.isfinite(v))
+    np.testing.assert_allclose(v, [500.0, 0.0], atol=1e-12)
+    g = LogisticLoss.d1(big, jnp.array([1.0, 1.0]))
+    np.testing.assert_allclose(g, [-1.0, 0.0], atol=1e-12)
+
+
+def test_logistic_accepts_pm1_labels():
+    # Reference doc: works for y in {0,1} and {-1,1} ("positive" = y > 0.5).
+    np.testing.assert_allclose(
+        LogisticLoss.loss(Z, -jnp.ones_like(Z)),
+        LogisticLoss.loss(Z, jnp.zeros_like(Z)),
+    )
+
+
+def test_squared_closed_form():
+    y = jnp.full_like(Z, 2.0)
+    np.testing.assert_allclose(SquaredLoss.loss(Z, y), 0.5 * (Z - 2.0) ** 2)
+
+
+def test_poisson_closed_form():
+    y = jnp.full_like(Z, 3.0)
+    np.testing.assert_allclose(PoissonLoss.loss(Z, y), jnp.exp(Z) - 3.0 * Z)
+
+
+def test_smoothed_hinge_regions():
+    y = jnp.ones((3,))
+    z = jnp.array([-1.0, 0.5, 2.0])  # t = z for positive labels
+    v = SmoothedHingeLoss.loss(z, y)
+    np.testing.assert_allclose(v, [1.5, 0.125, 0.0])
+    # negative label flips the margin sign
+    v_neg = SmoothedHingeLoss.loss(-z, jnp.zeros((3,)))
+    np.testing.assert_allclose(v_neg, v)

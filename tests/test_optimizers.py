@@ -1,0 +1,216 @@
+"""Optimizer tests vs closed forms.
+
+Mirrors the reference's pure unit tier: OptimizerTest / LBFGSTest / OWLQNTest
+/ TRONTest optimize TestObjective (a quadratic with known minimum,
+photon-lib src/test optimization/TestObjective.scala).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.ops.losses import LogisticLoss, SquaredLoss
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optimize import (
+    ConvergenceReason,
+    OptimizerConfig,
+    minimize_lbfgs,
+    minimize_owlqn,
+    minimize_tron,
+)
+from photon_tpu.types import LabeledBatch
+
+D = 8
+
+
+def _quadratic(center):
+    center = jnp.asarray(center)
+
+    def value_and_grad(x):
+        d = x - center
+        return 0.5 * jnp.dot(d, d), d
+
+    return value_and_grad
+
+
+def _quadratic_hvp(x, v):
+    return v
+
+
+def _ridge_batch(seed=0, n=200, d=D):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = x @ w_true + rng.normal(scale=0.1, size=n)
+    return LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros((n,)),
+        weights=jnp.ones((n,)),
+    )
+
+
+def _ridge_closed_form(batch, l2):
+    x = np.asarray(batch.features)
+    y = np.asarray(batch.labels)
+    d = x.shape[1]
+    return np.linalg.solve(x.T @ x + l2 * np.eye(d), x.T @ y)
+
+
+def test_lbfgs_quadratic_exact():
+    center = np.arange(1.0, D + 1)
+    res = minimize_lbfgs(_quadratic(center), jnp.zeros((D,)))
+    assert int(res.reason) in (
+        ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+        ConvergenceReason.GRADIENT_CONVERGED,
+    )
+    np.testing.assert_allclose(res.x, center, atol=1e-6)
+    # loss history is monotone non-increasing up to the final iteration
+    lh = np.asarray(res.loss_history)[: int(res.iterations) + 1]
+    assert np.all(np.diff(lh) <= 1e-12)
+
+
+def test_lbfgs_ridge_matches_closed_form():
+    batch = _ridge_batch()
+    l2 = 0.5
+    obj = GLMObjective(loss=SquaredLoss, l2_weight=l2)
+    res = minimize_lbfgs(
+        lambda w: obj.value_and_gradient(w, batch),
+        jnp.zeros((D,)),
+        OptimizerConfig(tolerance=1e-13),
+    )
+    np.testing.assert_allclose(res.x, _ridge_closed_form(batch, l2), atol=1e-6)
+
+
+def test_lbfgs_logistic_gradient_small_at_solution():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(300, D))
+    w_true = rng.normal(size=D)
+    y = (rng.uniform(size=300) < 1 / (1 + np.exp(-x @ w_true))).astype(float)
+    batch = LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros((300,)),
+        weights=jnp.ones((300,)),
+    )
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+    res = minimize_lbfgs(
+        lambda w: obj.value_and_gradient(w, batch),
+        jnp.zeros((D,)),
+        OptimizerConfig(tolerance=1e-13),
+    )
+    g = obj.gradient(res.x, batch)
+    assert float(jnp.linalg.norm(g)) < 1e-4
+
+
+def test_lbfgs_box_constraints():
+    center = np.full(D, 2.0)
+    lower = jnp.full((D,), -1.0)
+    upper = jnp.full((D,), 1.0)
+    cfg = OptimizerConfig(lower_bounds=lower, upper_bounds=upper)
+    res = minimize_lbfgs(_quadratic(center), jnp.zeros((D,)), cfg)
+    np.testing.assert_allclose(res.x, np.ones(D), atol=1e-6)
+
+
+def test_lbfgs_jit_and_warm_start():
+    batch = _ridge_batch()
+    obj = GLMObjective(loss=SquaredLoss, l2_weight=0.5)
+    solve = jax.jit(
+        lambda w0: minimize_lbfgs(
+            lambda w: obj.value_and_gradient(w, batch),
+            w0,
+            OptimizerConfig(tolerance=1e-13),
+        )
+    )
+    cold = solve(jnp.zeros((D,)))
+    warm = solve(cold.x)
+    # warm start from the solution terminates almost immediately
+    assert int(warm.iterations) <= 2
+    np.testing.assert_allclose(warm.x, cold.x, atol=1e-5)
+
+
+def test_owlqn_soft_threshold_orthogonal():
+    # With orthonormal design and squared loss, the lasso solution is
+    # soft-thresholding of the least-squares solution.
+    rng = np.random.default_rng(2)
+    q, _ = np.linalg.qr(rng.normal(size=(D, D)))
+    x = q.T  # orthonormal rows → X^T X = I
+    w_true = np.array([3.0, -2.0, 0.05, 0.0, 1.5, -0.02, 0.8, 0.0])
+    y = x @ w_true
+    batch = LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros((D,)),
+        weights=jnp.ones((D,)),
+    )
+    l1 = 0.1
+    obj = GLMObjective(loss=SquaredLoss)
+    res = minimize_owlqn(
+        lambda w: obj.value_and_gradient(w, batch), jnp.zeros((D,)), l1
+    )
+    wls = x.T @ y
+    expected = np.sign(wls) * np.maximum(np.abs(wls) - l1, 0.0)
+    np.testing.assert_allclose(res.x, expected, atol=1e-5)
+
+
+def test_owlqn_produces_sparsity():
+    batch = _ridge_batch(seed=3)
+    obj = GLMObjective(loss=SquaredLoss)
+    res = minimize_owlqn(
+        lambda w: obj.value_and_gradient(w, batch), jnp.zeros((D,)), 50.0
+    )
+    assert int(jnp.sum(res.x == 0.0)) >= 1
+
+
+def test_tron_quadratic_one_newton_step():
+    center = np.arange(1.0, D + 1)
+    res = minimize_tron(_quadratic(center), _quadratic_hvp, jnp.zeros((D,)))
+    np.testing.assert_allclose(res.x, center, atol=1e-6)
+    assert int(res.iterations) <= 3
+
+
+def test_tron_ridge_matches_closed_form():
+    batch = _ridge_batch(seed=4)
+    l2 = 0.5
+    obj = GLMObjective(loss=SquaredLoss, l2_weight=l2)
+    res = minimize_tron(
+        lambda w: obj.value_and_gradient(w, batch),
+        lambda w, v: obj.hessian_vector(w, v, batch),
+        jnp.zeros((D,)),
+        OptimizerConfig(max_iterations=50, tolerance=1e-13),
+    )
+    np.testing.assert_allclose(res.x, _ridge_closed_form(batch, l2), atol=1e-5)
+
+
+def test_tron_logistic_converges():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(300, D))
+    w_true = rng.normal(size=D)
+    y = (rng.uniform(size=300) < 1 / (1 + np.exp(-x @ w_true))).astype(float)
+    batch = LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros((300,)),
+        weights=jnp.ones((300,)),
+    )
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+    res = minimize_tron(
+        lambda w: obj.value_and_gradient(w, batch),
+        lambda w, v: obj.hessian_vector(w, v, batch),
+        jnp.zeros((D,)),
+    )
+    g = obj.gradient(res.x, batch)
+    assert float(jnp.linalg.norm(g)) < 1e-3
+
+
+def test_vmapped_lbfgs_batch_of_problems():
+    # The random-effect pattern: many independent small solves under vmap.
+    rng = np.random.default_rng(6)
+    centers = jnp.asarray(rng.normal(size=(16, D)))
+
+    def solve(center):
+        return minimize_lbfgs(_quadratic(center), jnp.zeros((D,)))
+
+    res = jax.vmap(solve)(centers)
+    np.testing.assert_allclose(res.x, centers, atol=1e-5)
+    assert res.x.shape == (16, D)
