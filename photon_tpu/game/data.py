@@ -1,0 +1,395 @@
+"""GAME data containers and the bucketed random-effect dataset build.
+
+TPU-native redesign of the reference's GAME data layer:
+
+- ``GameData`` replaces ``RDD[GameDatum]`` (data/GameDatum.scala:56-58,
+  GameConverters.scala:49-131) with a columnar host container: label /
+  offset / weight columns, one CSR matrix per feature shard, and one
+  string id column per entity tag. Sample identity is array position.
+
+- ``RandomEffectDataset`` replaces the reference's
+  ``activeData: RDD[(REId, LocalDataSet)]`` + projectors
+  (data/RandomEffectDataSet.scala:47-56, :239-265;
+  projector/IndexMapProjectorRDD.scala:34-110) with **size-bucketed, padded,
+  masked device arrays**: entities are grouped by (sample-count, projected-
+  feature-count) buckets; each bucket is a dense [E, n_max, d_max] block with
+  per-entity column index maps (the index-compaction projector), per-row
+  sample positions for score scatter, and an active-row mask produced by
+  reservoir sampling. One ``vmap``-ped L-BFGS per bucket replaces the
+  per-entity JVM solves (RandomEffectCoordinate.scala:104-127).
+
+Everything here is host-side numpy; device transfer happens in the
+coordinate layer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from photon_tpu.game.config import ProjectorType, RandomEffectCoordinateConfig
+from photon_tpu.ops.losses import POSITIVE_RESPONSE_THRESHOLD
+
+
+@dataclasses.dataclass
+class CSRMatrix:
+    """Features-only CSR block (one feature shard)."""
+
+    indptr: np.ndarray
+    indices: np.ndarray
+    values: np.ndarray
+    num_cols: int
+
+    @property
+    def num_rows(self) -> int:
+        return self.indptr.shape[0] - 1
+
+    def row(self, i: int) -> tuple[np.ndarray, np.ndarray]:
+        lo, hi = self.indptr[i], self.indptr[i + 1]
+        return self.indices[lo:hi], self.values[lo:hi]
+
+    def to_dense(self, dtype=np.float32) -> np.ndarray:
+        out = np.zeros((self.num_rows, self.num_cols), dtype=dtype)
+        rows = np.repeat(np.arange(self.num_rows), np.diff(self.indptr))
+        out[rows, self.indices] = self.values
+        return out
+
+    @staticmethod
+    def from_dense(x: np.ndarray) -> "CSRMatrix":
+        n, d = x.shape
+        mask = x != 0
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(mask.sum(axis=1), out=indptr[1:])
+        return CSRMatrix(
+            indptr=indptr,
+            indices=np.nonzero(mask)[1].astype(np.int32),
+            values=x[mask].astype(np.float64),
+            num_cols=d,
+        )
+
+
+@dataclasses.dataclass
+class GameData:
+    """Columnar GAME dataset: N samples, S feature shards, T id tags."""
+
+    labels: np.ndarray
+    offsets: np.ndarray
+    weights: np.ndarray
+    feature_shards: Mapping[str, CSRMatrix]
+    id_tags: Mapping[str, np.ndarray]  # tag → [N] array of entity keys
+
+    def __post_init__(self):
+        n = self.num_samples
+        for name, shard in self.feature_shards.items():
+            if shard.num_rows != n:
+                raise ValueError(f"shard {name} has {shard.num_rows} rows != {n}")
+        for tag, col in self.id_tags.items():
+            if len(col) != n:
+                raise ValueError(f"id tag {tag} has {len(col)} rows != {n}")
+
+    @property
+    def num_samples(self) -> int:
+        return self.labels.shape[0]
+
+    @staticmethod
+    def build(
+        labels: np.ndarray,
+        feature_shards: Mapping[str, CSRMatrix],
+        *,
+        offsets: np.ndarray | None = None,
+        weights: np.ndarray | None = None,
+        id_tags: Mapping[str, Sequence] | None = None,
+    ) -> "GameData":
+        n = len(labels)
+        return GameData(
+            labels=np.asarray(labels, dtype=np.float64),
+            offsets=np.zeros(n) if offsets is None else np.asarray(offsets),
+            weights=np.ones(n) if weights is None else np.asarray(weights),
+            feature_shards=dict(feature_shards),
+            id_tags={
+                t: np.asarray(v) for t, v in (id_tags or {}).items()
+            },
+        )
+
+
+# ---------------------------------------------------------------------------
+# Random-effect dataset build
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class REBucket:
+    """One (n_max, d_max) size bucket of entities, ready for device.
+
+    features: [E, n_max, d_max] dense projected features
+    labels/offsets/weights: [E, n_max] (weights 0 on padding)
+    active_mask: [E, n_max] 1.0 where the row participates in training
+    col_index: [E, d_max] global feature index per local column (-1 pad)
+    sample_pos: [E, n_max] global sample position (num_samples ⇒ pad,
+        out-of-bounds by construction so scatter-with-drop ignores it)
+    entity_ids: [E] dense entity index into the vocab
+    """
+
+    features: np.ndarray
+    labels: np.ndarray
+    offsets: np.ndarray
+    weights: np.ndarray
+    active_mask: np.ndarray
+    col_index: np.ndarray
+    sample_pos: np.ndarray
+    entity_ids: np.ndarray
+
+    @property
+    def num_entities(self) -> int:
+        return self.features.shape[0]
+
+    @property
+    def padded_samples(self) -> int:
+        return self.features.shape[1]
+
+    @property
+    def projected_dim(self) -> int:
+        return self.features.shape[2]
+
+
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """All buckets for one random-effect coordinate + entity vocabulary."""
+
+    random_effect_type: str
+    feature_shard: str
+    vocab: np.ndarray  # [num_entities] entity keys (strings)
+    entity_index: dict  # key → dense index
+    buckets: list[REBucket]
+    num_samples: int
+    num_features: int  # global feature dim of the shard
+    # Random-projection matrix when projector_type == RANDOM (else None):
+    projection_matrix: np.ndarray | None = None
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.vocab)
+
+    def total_active_samples(self) -> int:
+        return int(sum(b.active_mask.sum() for b in self.buckets))
+
+
+def _ceil_pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+def _pearson_top_features(
+    rows_idx: np.ndarray,
+    rows_val: np.ndarray,
+    rows_ptr: np.ndarray,
+    labels: np.ndarray,
+    cols: np.ndarray,
+    keep: int,
+    intercept_col: int | None,
+) -> np.ndarray:
+    """Keep the ``keep`` features with highest |Pearson corr(feature, label)|
+    (reference LocalDataSet.filterFeaturesByPearsonCorrelationScore:135,
+    score math :221-276). Constant features score 0 except the intercept,
+    which is always retained.
+    """
+    n = len(labels)
+    col_pos = {c: i for i, c in enumerate(cols)}
+    x = np.zeros((n, len(cols)))
+    for r in range(n):
+        lo, hi = rows_ptr[r], rows_ptr[r + 1]
+        for j, v in zip(rows_idx[lo:hi], rows_val[lo:hi]):
+            x[r, col_pos[j]] = v
+    xm = x - x.mean(axis=0)
+    ym = labels - labels.mean()
+    sx = np.sqrt((xm**2).sum(axis=0))
+    sy = np.sqrt((ym**2).sum())
+    denom = sx * sy
+    corr = np.zeros(len(cols))
+    nz = denom > 0
+    corr[nz] = np.abs((xm[:, nz] * ym[:, None]).sum(axis=0) / denom[nz])
+    if intercept_col is not None and intercept_col in col_pos:
+        corr[col_pos[intercept_col]] = np.inf  # always keep intercept
+    top = np.argsort(-corr)[:keep]
+    return np.sort(cols[top])
+
+
+def build_random_effect_dataset(
+    data: GameData,
+    config: RandomEffectCoordinateConfig,
+    *,
+    seed: int = 0,
+    intercept_col: int | None = None,
+) -> RandomEffectDataset:
+    """Group samples by entity, apply bounds/sampling/projection, bucket.
+
+    Mirrors RandomEffectDataSet.apply (:239-265): group by entity with a
+    reservoir-sampling training cap, drop entities below the lower bound,
+    per-entity feature selection, then — TPU-specific — pack entities into
+    power-of-two (n, d) buckets of padded dense blocks.
+    """
+    rng = np.random.default_rng(seed)
+    shard = data.feature_shards[config.feature_shard]
+    keys = data.id_tags[config.random_effect_type]
+    n = data.num_samples
+
+    # entity vocabulary and per-sample dense entity index
+    vocab, entity_of_sample = np.unique(keys, return_inverse=True)
+    counts = np.bincount(entity_of_sample, minlength=len(vocab))
+
+    # sort sample indices by entity for contiguous grouping
+    order = np.argsort(entity_of_sample, kind="stable")
+    group_starts = np.zeros(len(vocab) + 1, dtype=np.int64)
+    np.cumsum(counts, out=group_starts[1:])
+
+    rnd_proj = None
+    if config.projector_type == ProjectorType.RANDOM:
+        k = config.random_projection_dim or 64
+        rnd_proj = rng.normal(size=(shard.num_cols, k)) / np.sqrt(k)
+
+    # per-entity prep: active mask, projected columns
+    entities = []
+    for e in range(len(vocab)):
+        rows = order[group_starts[e] : group_starts[e + 1]]
+        if len(rows) < config.active_data_lower_bound:
+            continue  # no model for this entity
+        # reservoir cap on *training* rows; all rows stay for scoring
+        active = rows
+        if (
+            config.active_data_upper_bound is not None
+            and len(rows) > config.active_data_upper_bound
+        ):
+            sel = rng.choice(
+                len(rows), size=config.active_data_upper_bound, replace=False
+            )
+            active = rows[np.sort(sel)]
+        active_set = set(active.tolist())
+
+        if rnd_proj is None:
+            # index-compaction projection: union of active-row features
+            cols = np.unique(shard.indices[
+                np.concatenate(
+                    [np.arange(shard.indptr[r], shard.indptr[r + 1]) for r in rows]
+                )
+                if len(rows)
+                else np.array([], dtype=np.int64)
+            ]).astype(np.int64)
+            # Pearson cap
+            cap = None
+            if config.features_to_samples_ratio is not None:
+                cap = max(1, int(config.features_to_samples_ratio * len(active)))
+            if cap is not None and len(cols) > cap:
+                sub_ptr = np.zeros(len(active) + 1, dtype=np.int64)
+                sub_idx, sub_val = [], []
+                for i, r in enumerate(active):
+                    ci, cv = shard.row(r)
+                    sub_idx.append(ci)
+                    sub_val.append(cv)
+                    sub_ptr[i + 1] = sub_ptr[i] + len(ci)
+                cols = _pearson_top_features(
+                    np.concatenate(sub_idx) if sub_idx else np.array([], np.int64),
+                    np.concatenate(sub_val) if sub_val else np.array([]),
+                    sub_ptr,
+                    data.labels[active],
+                    cols,
+                    cap,
+                    intercept_col,
+                )
+            d_proj = len(cols)
+        else:
+            cols = None
+            d_proj = rnd_proj.shape[1]
+        entities.append((e, rows, active_set, cols, d_proj))
+
+    # bucket by (padded n, padded d)
+    bucket_map: dict[tuple[int, int], list] = {}
+    for ent in entities:
+        _, rows, _, _, d_proj = ent
+        key = (_ceil_pow2(len(rows)), _ceil_pow2(max(d_proj, 1)))
+        bucket_map.setdefault(key, []).append(ent)
+
+    buckets = []
+    for (n_max, d_max), ents in sorted(bucket_map.items()):
+        E = len(ents)
+        feats = np.zeros((E, n_max, d_max), dtype=np.float32)
+        labels = np.zeros((E, n_max), dtype=np.float32)
+        offsets = np.zeros((E, n_max), dtype=np.float32)
+        weights = np.zeros((E, n_max), dtype=np.float32)
+        active_mask = np.zeros((E, n_max), dtype=np.float32)
+        col_index = np.full((E, d_max), -1, dtype=np.int32)
+        sample_pos = np.full((E, n_max), n, dtype=np.int32)  # n ⇒ OOB pad
+        entity_ids = np.zeros((E,), dtype=np.int32)
+        for b, (e, rows, active_set, cols, d_proj) in enumerate(ents):
+            entity_ids[b] = e
+            if cols is not None:
+                col_index[b, : len(cols)] = cols
+                col_of = {c: i for i, c in enumerate(cols)}
+            for i, r in enumerate(rows):
+                labels[b, i] = data.labels[r]
+                offsets[b, i] = data.offsets[r]
+                weights[b, i] = data.weights[r]
+                active_mask[b, i] = 1.0 if r in active_set else 0.0
+                sample_pos[b, i] = r
+                ci, cv = shard.row(r)
+                if cols is not None:
+                    for j, v in zip(ci, cv):
+                        lj = col_of.get(j)
+                        if lj is not None:
+                            feats[b, i, lj] = v
+                else:
+                    feats[b, i, :] = cv @ rnd_proj[ci] if len(ci) else 0.0
+        buckets.append(
+            REBucket(
+                features=feats,
+                labels=labels,
+                offsets=offsets,
+                weights=weights,
+                active_mask=active_mask,
+                col_index=col_index,
+                sample_pos=sample_pos,
+                entity_ids=entity_ids,
+            )
+        )
+
+    return RandomEffectDataset(
+        random_effect_type=config.random_effect_type,
+        feature_shard=config.feature_shard,
+        vocab=vocab,
+        entity_index={k: i for i, k in enumerate(vocab)},
+        buckets=buckets,
+        num_samples=n,
+        num_features=shard.num_cols,
+        projection_matrix=rnd_proj,
+    )
+
+
+def balanced_entity_assignment(
+    counts: np.ndarray, num_shards: int, heavy_top_k: int = 10000
+) -> np.ndarray:
+    """Greedy bin-packing of the heaviest entities + hashing for the rest
+    (reference RandomEffectDataSetPartitioner.scala:113-147). Returns a
+    shard id per entity — used to split buckets across the mesh entity axis.
+    """
+    assignment = np.empty(len(counts), dtype=np.int32)
+    order = np.argsort(-counts)
+    heavy = order[: min(heavy_top_k, len(order))]
+    light = order[min(heavy_top_k, len(order)) :]
+    load = np.zeros(num_shards, dtype=np.int64)
+    for e in heavy:
+        s = int(np.argmin(load))
+        assignment[e] = s
+        load[s] += counts[e]
+    assignment[light] = light % num_shards
+    return assignment
+
+
+def labels_are_binary(labels: np.ndarray) -> bool:
+    u = set(np.unique(labels))
+    return u <= {0.0, 1.0} or u <= {-1.0, 1.0}
+
+
+def positive_rate(labels: np.ndarray) -> float:
+    return float((labels > POSITIVE_RESPONSE_THRESHOLD).mean())

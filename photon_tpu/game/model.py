@@ -1,0 +1,161 @@
+"""GAME model containers: fixed-effect, random-effect, combined.
+
+Reference parity: photon-api model/FixedEffectModel.scala (broadcast GLM +
+featureShardId), model/RandomEffectModel.scala (``RDD[(REId, GLM)]``),
+photon-lib model/GameModel.scala:32 (``Map[CoordinateId,
+DatumScoringModel]``). The random-effect model keeps the TPU layout —
+per-bucket padded coefficient blocks plus the per-entity column index maps
+(projected space) — instead of an RDD of per-entity models; scoring is an
+einsum per bucket + scatter, not a join.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.game.data import GameData, RandomEffectDataset
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.glm import GeneralizedLinearModel, model_for_task
+from photon_tpu.types import Array, TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """One GLM applied to every sample's shard features."""
+
+    model: GeneralizedLinearModel
+    feature_shard: str
+
+    def score(self, data: GameData) -> np.ndarray:
+        """x·w per sample (offsets excluded — coordinate scores compose
+        additively like the reference's CoordinateDataScores)."""
+        shard = data.feature_shards[self.feature_shard]
+        w = np.asarray(self.model.coefficients.means, dtype=np.float64)
+        contrib = shard.values * w[shard.indices]
+        rows = np.repeat(np.arange(shard.num_rows), np.diff(shard.indptr))
+        scores = np.zeros(shard.num_rows)
+        np.add.at(scores, rows, contrib)
+        return scores
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketCoefficients:
+    """Coefficients for one RE bucket: [E, d_max] in projected space."""
+
+    entity_ids: np.ndarray  # [E] dense entity index
+    col_index: np.ndarray  # [E, d_max] global feature ids (-1 pad)
+    coefficients: np.ndarray  # [E, d_max]
+    variances: np.ndarray | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity GLMs in their projected subspaces.
+
+    ``to_sparse_coefficients`` projects each entity's vector back to the
+    global feature space (reference RandomEffectModelInProjectedSpace →
+    RandomEffectProjector.projectCoefficientsRDD).
+    """
+
+    random_effect_type: str
+    feature_shard: str
+    task: TaskType
+    vocab: np.ndarray
+    buckets: tuple[BucketCoefficients, ...]
+    num_features: int
+    projection_matrix: np.ndarray | None = None
+
+    def score(self, data: GameData, dataset: RandomEffectDataset) -> np.ndarray:
+        """Scores aligned to sample position, via the dataset's buckets."""
+        n = data.num_samples
+        scores = np.zeros(n + 1)  # +1 slot swallows padding scatter
+        for bucket, coefs in zip(dataset.buckets, self.buckets):
+            s = np.einsum("end,ed->en", bucket.features, coefs.coefficients)
+            np.add.at(scores, bucket.sample_pos.ravel(), s.ravel())
+        return scores[:n]
+
+    def score_cold(self, data: GameData) -> np.ndarray:
+        """Score arbitrary data by entity lookup (unseen entities → 0),
+        the reference's scoring-time join on REId."""
+        shard = data.feature_shards[self.feature_shard]
+        keys = data.id_tags[self.random_effect_type]
+        entity_vec = self.dense_coefficient_lookup()
+        index = {k: i for i, k in enumerate(self.vocab)}
+        scores = np.zeros(data.num_samples)
+        for r in range(data.num_samples):
+            e = index.get(keys[r])
+            if e is None or entity_vec[e] is None:
+                continue
+            ci, cv = shard.row(r)
+            if self.projection_matrix is not None:
+                proj = cv @ self.projection_matrix[ci] if len(ci) else 0.0
+                scores[r] = float(np.dot(proj, entity_vec[e]))
+            else:
+                scores[r] = float(entity_vec[e][ci] @ cv)
+        return scores
+
+    def dense_coefficient_lookup(self) -> list:
+        """entity dense-index → global-space coefficient vector (or
+        projected vector under random projection); None if unmodeled."""
+        out: list = [None] * len(self.vocab)
+        for b in self.buckets:
+            for i, e in enumerate(b.entity_ids):
+                if self.projection_matrix is not None:
+                    out[e] = b.coefficients[i]
+                else:
+                    w = np.zeros(self.num_features)
+                    cols = b.col_index[i]
+                    valid = cols >= 0
+                    w[cols[valid]] = b.coefficients[i][valid]
+                    out[e] = w
+        return out
+
+    def entity_model(self, key: str) -> GeneralizedLinearModel | None:
+        """Materialize one entity's GLM (diagnostics / persistence)."""
+        idx = np.flatnonzero(self.vocab == key)
+        if len(idx) == 0:
+            return None
+        lookup = self.dense_coefficient_lookup()
+        w = lookup[int(idx[0])]
+        if w is None:
+            return None
+        return model_for_task(self.task, Coefficients(means=jnp.asarray(w)))
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """coordinate id → model, scored additively (reference GameModel.scala:32;
+    score composition mirrors GameTransformer.scoreGameDataSet:269)."""
+
+    coordinates: Mapping[str, FixedEffectModel | RandomEffectModel]
+    task: TaskType
+
+    def score(
+        self,
+        data: GameData,
+        datasets: Mapping[str, RandomEffectDataset] | None = None,
+    ) -> np.ndarray:
+        """Sum of coordinate scores (margins, before offsets/link)."""
+        total = np.zeros(data.num_samples)
+        for cid, model in self.coordinates.items():
+            if isinstance(model, FixedEffectModel):
+                total += model.score(data)
+            elif datasets is not None and cid in datasets:
+                total += model.score(data, datasets[cid])
+            else:
+                total += model.score_cold(data)
+        return total
+
+    def predict(self, data: GameData, **kw) -> np.ndarray:
+        """Mean response: link applied to score + offset."""
+        margins = self.score(data, **kw) + data.offsets
+        glm = model_for_task(
+            self.task, Coefficients(means=jnp.zeros((1,)))
+        )
+        return np.asarray(glm.compute_mean(jnp.asarray(margins)))
+
+    def __getitem__(self, cid: str):
+        return self.coordinates[cid]

@@ -1,0 +1,54 @@
+"""GameTransformer: score a GameData with a trained GameModel.
+
+Reference parity: photon-api transformers/GameTransformer.scala:156-269 —
+DataFrame → GameDatum → per-coordinate scores summed → ModelDataScores,
+with optional evaluators; logged timings.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_tpu.evaluation.evaluators import EvaluatorType
+from photon_tpu.evaluation.multi import MultiEvaluator
+from photon_tpu.game.data import GameData
+from photon_tpu.game.model import GameModel
+from photon_tpu.types import TaskType
+
+
+@dataclasses.dataclass
+class GameTransformer:
+    model: GameModel
+    task: TaskType
+
+    def score(self, data: GameData) -> np.ndarray:
+        """Total margin per sample: Σ coordinate scores + data offsets
+        (reference ModelDataScores carries offsets through evaluation)."""
+        return self.model.score(data) + data.offsets
+
+    def predict(self, data: GameData) -> np.ndarray:
+        return self.model.predict(data)
+
+    def evaluate(self, data: GameData, evaluator: EvaluatorType) -> float:
+        from photon_tpu.evaluation.evaluators import evaluate as _eval
+
+        import jax.numpy as jnp
+
+        scores = self.score(data)
+        return float(
+            _eval(
+                evaluator,
+                jnp.asarray(scores),
+                jnp.asarray(data.labels),
+                jnp.asarray(data.weights),
+            )
+        )
+
+    def evaluate_grouped(
+        self, data: GameData, evaluator: MultiEvaluator, id_tag: str
+    ) -> float:
+        """Per-entity grouped evaluation (reference MultiEvaluator path)."""
+        return evaluator(
+            self.score(data), data.labels, data.id_tags[id_tag]
+        )

@@ -1,0 +1,108 @@
+"""Single-GLM training over a regularization-weight grid with warm starts.
+
+Reference parity: photon-api ModelTraining.trainGeneralizedLinearModel
+(ModelTraining.scala:55, 106-229): one model per λ, warm-starting each solve
+from the previous λ's coefficients, with optional box constraints,
+normalization, and per-model state tracking. This is the legacy-Driver
+training path (Driver.scala:334); the GAME path builds on the same
+GLMProblem through the coordinate-descent machinery.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from photon_tpu.data.dataset import DataSet, to_device_batch
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.glm import GeneralizedLinearModel, model_for_task
+from photon_tpu.ops.normalization import NormalizationContext
+from photon_tpu.optimize.common import OptimizeResult
+from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
+from photon_tpu.types import Array, LabeledBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainedModel:
+    """One (λ, model, optimization history) row of the training output
+    (reference ModelTracker + per-λ model list)."""
+
+    regularization_weight: float
+    model: GeneralizedLinearModel
+    result: OptimizeResult
+    wall_time_s: float
+
+
+def train_glm_grid(
+    data: DataSet | LabeledBatch,
+    base_config: GLMProblemConfig,
+    regularization_weights: Sequence[float],
+    *,
+    normalization: NormalizationContext = NormalizationContext(),
+    warm_start: bool = True,
+    initial_coefficients: Array | None = None,
+    dtype=jnp.float32,
+) -> list[TrainedModel]:
+    """Train one GLM per λ, descending the grid with warm starts.
+
+    The reference sorts weights descending so each warm start moves to a
+    less-regularized problem (ModelTraining.scala:165+); we preserve the
+    caller's order but chain coefficients the same way.
+
+    Models are returned in the *original space* (normalization undone),
+    like the reference's post-optimization conversion.
+    """
+    batch = (
+        data
+        if isinstance(data, LabeledBatch)
+        else to_device_batch(data, dtype=dtype)
+    )
+    d = batch.num_features
+
+    results: list[TrainedModel] = []
+    w = (
+        jnp.zeros((d,), dtype=batch.features.dtype)
+        if initial_coefficients is None
+        else jnp.asarray(initial_coefficients, dtype=batch.features.dtype)
+    )
+    # Optimization happens in the transformed space.
+    w = normalization.model_to_transformed_space(w)
+
+    for reg_weight in regularization_weights:
+        problem = GLMProblem.build(
+            base_config.with_regularization_weight(reg_weight), normalization
+        )
+        sampler = problem.down_sampler()
+        solve_batch = batch
+        if sampler is not None and isinstance(data, DataSet):
+            solve_batch = to_device_batch(sampler.downsample(data), dtype=dtype)
+
+        t0 = time.perf_counter()
+        result = problem.solve(solve_batch, w)
+        result.x.block_until_ready()
+        wall = time.perf_counter() - t0
+
+        variances_t = problem.variances(batch, result.x)
+        w_model = normalization.model_to_original_space(result.x)
+        variances = None
+        if variances_t is not None:
+            # Variance transforms with the square of the factors.
+            f = normalization.factors
+            variances = variances_t if f is None else variances_t * f * f
+        model = model_for_task(
+            base_config.task, Coefficients(means=w_model, variances=variances)
+        )
+        results.append(
+            TrainedModel(
+                regularization_weight=reg_weight,
+                model=model,
+                result=result,
+                wall_time_s=wall,
+            )
+        )
+        if warm_start:
+            w = result.x
+
+    return results

@@ -1,0 +1,7 @@
+from photon_tpu.parallel.mesh import (  # noqa: F401
+    BATCH_AXIS,
+    ENTITY_AXIS,
+    make_mesh,
+    replicate,
+    shard_batch,
+)

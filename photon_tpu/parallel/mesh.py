@@ -1,0 +1,90 @@
+"""Device mesh and sharding helpers — the distributed communication backend.
+
+This replaces the reference's Spark-RDD machinery (SURVEY.md §5.8): where
+Photon-ML reduces gradients with ``RDD.treeAggregate(depth)`` and re-broadcasts
+coefficients every evaluation (ValueAndGradientAggregator.scala:244-247,
+DistributedGLMLossFunction.scala:64), the TPU build shards the batch axis of
+the one jit-compiled program over a ``jax.sharding.Mesh`` and lets XLA insert
+``psum`` over ICI (and over DCN for the pod-slice outer axis). The tree shape
+is the compiler's problem — the reference's ``treeAggregateDepth`` parameter
+has no equivalent because it is no longer needed.
+
+Axes:
+- ``data``  — batch rows (data parallelism; the reference's RDD partitions)
+- ``entity`` — random-effect entities (the reference's entity partitioner,
+  RandomEffectDataSetPartitioner.scala:113-147, becomes a static
+  entity→shard assignment at dataset build)
+
+Multi-host: under ``jax.distributed`` the same Mesh spans hosts; nothing in
+this module changes — collectives ride ICI within a slice and DCN across
+slices, which is exactly the scaling story the reference delegates to
+Spark's shuffle service.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_tpu.types import LabeledBatch, PyTree
+
+BATCH_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+
+def make_mesh(
+    num_data: int | None = None,
+    num_entity: int = 1,
+    *,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a (data, entity) mesh over the available devices.
+
+    Default: all devices on the data axis. ``num_data`` × ``num_entity``
+    must equal the device count when both are given.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if num_data is None:
+        num_data = n // num_entity
+    if num_data * num_entity != n:
+        raise ValueError(
+            f"mesh {num_data}x{num_entity} does not cover {n} devices"
+        )
+    arr = np.asarray(devices).reshape(num_data, num_entity)
+    return Mesh(arr, (BATCH_AXIS, ENTITY_AXIS))
+
+
+def shard_batch(batch: LabeledBatch, mesh: Mesh) -> LabeledBatch:
+    """Place a batch with rows sharded over the data axis (features'
+    feature-dimension replicated)."""
+    row_sharded = NamedSharding(mesh, P(BATCH_AXIS))
+    mat_sharded = NamedSharding(mesh, P(BATCH_AXIS, None))
+    return LabeledBatch(
+        features=jax.device_put(batch.features, mat_sharded),
+        labels=jax.device_put(batch.labels, row_sharded),
+        offsets=jax.device_put(batch.offsets, row_sharded),
+        weights=jax.device_put(batch.weights, row_sharded),
+    )
+
+
+def shard_entities(tree: PyTree, mesh: Mesh, axis: int = 0) -> PyTree:
+    """Shard leading (entity) axis of every leaf over the entity mesh axis —
+    the random-effect table layout ([num_entities, ...] entity-sharded)."""
+    def put(x):
+        p = P(*([ENTITY_AXIS] + [None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, p))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Fully replicate a pytree over the mesh (the reference's broadcast —
+    but done once; jit keeps it on-device across iterations)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def pad_rows_to_multiple(n: int, devices: int) -> int:
+    """Round a row count up so it divides evenly across ``devices``."""
+    return ((n + devices - 1) // devices) * devices

@@ -1,0 +1,90 @@
+"""Evaluator tests vs hand-computed values and invariances."""
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.evaluation.evaluators import (
+    EvaluatorType,
+    area_under_pr_curve,
+    area_under_roc_curve,
+    evaluate,
+    rmse,
+)
+from photon_tpu.evaluation.multi import MultiEvaluator
+
+
+def test_auc_hand_example():
+    # scores: perfect ranking → AUC 1; inverted → 0
+    y = jnp.array([1.0, 1.0, 0.0, 0.0])
+    s = jnp.array([0.9, 0.8, 0.2, 0.1])
+    assert float(area_under_roc_curve(s, y)) == 1.0
+    assert float(area_under_roc_curve(-s, y)) == 0.0
+
+
+def test_auc_with_ties_and_mask():
+    y = jnp.array([1.0, 0.0, 1.0, 0.0])
+    s = jnp.array([0.5, 0.5, 0.5, 0.5])
+    assert float(area_under_roc_curve(s, y)) == 0.5
+    # masked rows (weight 0) must not affect the value
+    y2 = jnp.array([1.0, 0.0, 1.0, 0.0, 1.0, 1.0])
+    s2 = jnp.array([0.9, 0.1, 0.7, 0.3, 99.0, -99.0])
+    w2 = jnp.array([1.0, 1.0, 1.0, 1.0, 0.0, 0.0])
+    full = area_under_roc_curve(jnp.array([0.9, 0.1, 0.7, 0.3]),
+                                jnp.array([1.0, 0.0, 1.0, 0.0]))
+    np.testing.assert_allclose(float(area_under_roc_curve(s2, y2, w2)), float(full))
+
+
+def test_auc_monotone_invariant():
+    rng = np.random.default_rng(0)
+    s = jnp.asarray(rng.normal(size=50))
+    y = jnp.asarray((rng.uniform(size=50) > 0.5).astype(float))
+    a1 = float(area_under_roc_curve(s, y))
+    a2 = float(area_under_roc_curve(jnp.tanh(s / 3), y))  # monotone transform
+    np.testing.assert_allclose(a1, a2, atol=1e-12)
+
+
+def test_aupr_perfect_and_random():
+    y = jnp.array([1.0, 1.0, 0.0, 0.0])
+    s = jnp.array([0.9, 0.8, 0.2, 0.1])
+    assert float(area_under_pr_curve(s, y)) == 1.0
+
+
+def test_rmse_weighted():
+    s = jnp.array([1.0, 3.0])
+    y = jnp.array([0.0, 0.0])
+    w = jnp.array([1.0, 3.0])
+    expected = np.sqrt((1.0 * 1 + 9.0 * 3) / 4)
+    np.testing.assert_allclose(float(rmse(s, y, w)), expected)
+
+
+def test_evaluator_dispatch():
+    y = jnp.array([1.0, 0.0])
+    s = jnp.array([2.0, -2.0])
+    v = float(evaluate(EvaluatorType.LOGISTIC_LOSS, s, y))
+    expected = np.log1p(np.exp(-2.0)) * 2
+    np.testing.assert_allclose(v, expected, rtol=1e-6)
+
+
+def test_multi_evaluator_grouped_auc():
+    # two groups: one perfectly ranked, one inverted → mean 0.5
+    scores = np.array([0.9, 0.1, 0.1, 0.9])
+    labels = np.array([1.0, 0.0, 1.0, 0.0])
+    groups = np.array(["a", "a", "b", "b"])
+    v = MultiEvaluator.auc()(scores, labels, groups)
+    np.testing.assert_allclose(v, 0.5)
+
+
+def test_multi_evaluator_skips_single_class_groups():
+    scores = np.array([0.9, 0.1, 0.5, 0.6])
+    labels = np.array([1.0, 0.0, 1.0, 1.0])  # group b all positive
+    groups = np.array(["a", "a", "b", "b"])
+    v = MultiEvaluator.auc()(scores, labels, groups)
+    np.testing.assert_allclose(v, 1.0)  # only group a counts
+
+
+def test_precision_at_k():
+    scores = np.array([0.9, 0.8, 0.1, 0.95, 0.2, 0.3])
+    labels = np.array([1.0, 0.0, 1.0, 1.0, 0.0, 1.0])
+    groups = np.array(["a", "a", "a", "b", "b", "b"])
+    v = MultiEvaluator.precision_at_k(2)(scores, labels, groups)
+    # group a top2: [0.9→1, 0.8→0] = 0.5 ; group b top2: [0.95→1, 0.3→1] = 1.0
+    np.testing.assert_allclose(v, 0.75)

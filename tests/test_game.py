@@ -1,0 +1,225 @@
+"""GAME integration tests on synthetic mixed-effect data.
+
+Mirrors the reference's GameEstimatorIntegTest / RandomEffectCoordinate
+IntegTest tier: a fixed effect plus per-entity random effects generate the
+labels; training must recover both parts and beat the fixed-effect-only
+model on held-out entities' data.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.evaluation.evaluators import EvaluatorType
+from photon_tpu.game import (
+    CSRMatrix,
+    FixedEffectCoordinateConfig,
+    GameData,
+    GameEstimator,
+    GameTransformer,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import build_random_effect_dataset
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import GLMProblemConfig
+from photon_tpu.types import TaskType
+
+D_FIXED = 6
+D_RE = 3
+N_USERS = 20
+
+
+def _make_game_data(seed=0, n=600, task="linear"):
+    rng = np.random.default_rng(seed)
+    x_fixed = rng.normal(size=(n, D_FIXED))
+    x_re = rng.normal(size=(n, D_RE))
+    users = rng.integers(0, N_USERS, size=n)
+    w_fixed = rng.normal(size=D_FIXED)
+    w_users = rng.normal(size=(N_USERS, D_RE))
+
+    margin = x_fixed @ w_fixed + np.einsum("nd,nd->n", x_re, w_users[users])
+    if task == "linear":
+        y = margin + rng.normal(scale=0.05, size=n)
+    else:
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+
+    data = GameData.build(
+        labels=y,
+        feature_shards={
+            "global": CSRMatrix.from_dense(x_fixed),
+            "per_user": CSRMatrix.from_dense(x_re),
+        },
+        id_tags={"userId": np.array([f"u{u}" for u in users])},
+    )
+    return data, w_fixed, w_users, users
+
+
+def _configs(task=TaskType.LINEAR_REGRESSION, re_l2=0.1, fe_l2=0.0):
+    opt = GLMProblemConfig(
+        task=task, optimizer_config=OptimizerConfig(tolerance=1e-10)
+    )
+    fe = FixedEffectCoordinateConfig(
+        feature_shard="global",
+        optimization=opt,
+        regularization_weights=(fe_l2,),
+    )
+    re = RandomEffectCoordinateConfig(
+        random_effect_type="userId",
+        feature_shard="per_user",
+        optimization=opt,
+        regularization_weights=(re_l2,),
+    )
+    return {"fixed": fe, "per-user": re}
+
+
+def test_random_effect_dataset_build():
+    data, *_ = _make_game_data()
+    cfg = _configs()["per-user"]
+    ds = build_random_effect_dataset(data, cfg)
+    assert ds.num_entities == N_USERS
+    total_rows = sum(
+        int((b.sample_pos < data.num_samples).sum()) for b in ds.buckets
+    )
+    assert total_rows == data.num_samples
+    # every entity appears exactly once across buckets
+    ents = np.concatenate([b.entity_ids for b in ds.buckets])
+    assert sorted(ents.tolist()) == list(range(N_USERS))
+    # padding rows have zero weight
+    for b in ds.buckets:
+        pad = b.sample_pos >= data.num_samples
+        assert np.all(b.weights[pad] == 0)
+
+
+def test_reservoir_cap_and_lower_bound():
+    data, *_ = _make_game_data(n=400)
+    cfg = _configs()["per-user"]
+    import dataclasses
+
+    capped = dataclasses.replace(
+        cfg, active_data_upper_bound=5, active_data_lower_bound=3
+    )
+    ds = build_random_effect_dataset(data, capped)
+    for b in ds.buckets:
+        active_per_entity = (b.active_mask * (b.weights > 0)).sum(axis=1)
+        assert np.all(active_per_entity <= 5)
+        assert np.all(active_per_entity >= 3)
+
+
+def test_game_fit_recovers_mixed_effects():
+    data, w_fixed, w_users, users = _make_game_data()
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=_configs(re_l2=0.01),
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=4,
+        dtype=jnp.float64,
+    )
+    result = est.fit(data)[0]
+    model = result.model
+
+    # combined model fits far better than the fixed effect alone
+    scores_full = model.score(data)
+    fe_scores = model["fixed"].score(data)
+    resid_full = float(np.mean((scores_full - data.labels) ** 2))
+    resid_fe = float(np.mean((fe_scores - data.labels) ** 2))
+    assert resid_full < 0.05
+    assert resid_full < resid_fe / 5
+
+    # per-user coefficients close to the generating ones
+    lookup = model["per-user"].dense_coefficient_lookup()
+    vocab = model["per-user"].vocab
+    errs = []
+    for i, key in enumerate(vocab):
+        u = int(key[1:])
+        if lookup[i] is not None:
+            errs.append(np.linalg.norm(lookup[i] - w_users[u]))
+    assert np.median(errs) < 0.25
+
+
+def test_game_logistic_auc_improves_with_random_effects():
+    data, *_ = _make_game_data(seed=1, task="logistic")
+    base_cfg = _configs(task=TaskType.LOGISTIC_REGRESSION, re_l2=1.0, fe_l2=0.1)
+
+    est_fe_only = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={"fixed": base_cfg["fixed"]},
+        update_sequence=["fixed"],
+        descent_iterations=1,
+    )
+    est_full = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs=base_cfg,
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=3,
+    )
+    m_fe = est_fe_only.fit(data)[0].model
+    m_full = est_full.fit(data)[0].model
+
+    t_fe = GameTransformer(model=m_fe, task=TaskType.LOGISTIC_REGRESSION)
+    t_full = GameTransformer(model=m_full, task=TaskType.LOGISTIC_REGRESSION)
+    auc_fe = t_fe.evaluate(data, EvaluatorType.AUC)
+    auc_full = t_full.evaluate(data, EvaluatorType.AUC)
+    assert auc_full > auc_fe + 0.05
+    assert auc_full > 0.8
+
+
+def test_locked_coordinates_not_retrained():
+    data, *_ = _make_game_data(seed=2)
+    cfgs = _configs()
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=cfgs,
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=2,
+        dtype=jnp.float64,
+    )
+    base = est.fit(data)[0].model
+
+    # retrain only per-user, keeping fixed locked at the prior model
+    est2 = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=cfgs,
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=2,
+        locked_coordinates=frozenset({"fixed"}),
+        dtype=jnp.float64,
+    )
+    out = est2.fit(data, initial_model=base)[0].model
+    np.testing.assert_allclose(
+        out["fixed"].model.coefficients.means,
+        base["fixed"].model.coefficients.means,
+        rtol=1e-12,
+    )
+
+
+def test_cold_scoring_matches_dataset_scoring():
+    data, *_ = _make_game_data(seed=3, n=300)
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=_configs(),
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=2,
+        dtype=jnp.float64,
+    )
+    model = est.fit(data)[0].model
+    re_model = model["per-user"]
+    ds = build_random_effect_dataset(data, _configs()["per-user"])
+    via_buckets = re_model.score(data, ds)
+    via_lookup = re_model.score_cold(data)
+    np.testing.assert_allclose(via_buckets, via_lookup, atol=1e-5)
+
+
+def test_validation_tracking_selects_best():
+    data, *_ = _make_game_data(seed=4, task="logistic")
+    val_data, *_ = _make_game_data(seed=5, task="logistic")
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs=_configs(
+            task=TaskType.LOGISTIC_REGRESSION, re_l2=1.0, fe_l2=0.1
+        ),
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=2,
+        validation_evaluator=EvaluatorType.AUC,
+    )
+    result = est.fit(data, validation_data=val_data)[0]
+    assert result.evaluation is not None
+    assert 0.0 <= result.evaluation <= 1.0

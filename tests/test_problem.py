@@ -1,0 +1,135 @@
+"""Optimization-problem layer tests: regularization mixing, variance
+computation vs numpy, λ-grid warm start (reference
+DistributedOptimizationProblemIntegTest / ModelTrainingTest analogues).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import DataSet
+from photon_tpu.model_training import train_glm_grid
+from photon_tpu.ops.normalization import NormalizationContext
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblem,
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+    VarianceComputationType,
+)
+from photon_tpu.types import LabeledBatch, NormalizationType, OptimizerType, TaskType
+
+D = 6
+
+
+def _batch(seed=0, n=128):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, D))
+    w = rng.normal(size=D)
+    y = x @ w + rng.normal(scale=0.1, size=n)
+    return LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros((n,)),
+        weights=jnp.ones((n,)),
+    )
+
+
+def test_regularization_mixing():
+    ctx = RegularizationContext(RegularizationType.ELASTIC_NET, elastic_net_alpha=0.3)
+    assert ctx.l1_weight(10.0) == pytest.approx(3.0)
+    assert ctx.l2_weight(10.0) == pytest.approx(7.0)
+    l2 = RegularizationContext(RegularizationType.L2)
+    assert l2.l1_weight(10.0) == 0.0 and l2.l2_weight(10.0) == 10.0
+    with pytest.raises(ValueError):
+        RegularizationContext(RegularizationType.ELASTIC_NET, elastic_net_alpha=1.5)
+
+
+def test_tron_rejects_smoothed_hinge():
+    cfg = GLMProblemConfig(
+        task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM, optimizer=OptimizerType.TRON
+    )
+    with pytest.raises(ValueError, match="twice-differentiable"):
+        GLMProblem.build(cfg)
+
+
+def test_full_variance_matches_numpy_inverse():
+    batch = _batch()
+    cfg = GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=0.5,
+        variance_computation=VarianceComputationType.FULL,
+    )
+    problem = GLMProblem.build(cfg)
+    res = problem.solve(batch, jnp.zeros((D,)))
+    v = problem.variances(batch, res.x)
+    x = np.asarray(batch.features)
+    h = x.T @ x + 0.5 * np.eye(D)
+    np.testing.assert_allclose(v, np.diagonal(np.linalg.inv(h)), rtol=1e-6)
+
+    import dataclasses
+
+    simple = GLMProblem.build(
+        dataclasses.replace(cfg, variance_computation=VarianceComputationType.SIMPLE)
+    )
+    vs = simple.variances(batch, res.x)
+    np.testing.assert_allclose(vs, 1.0 / np.diagonal(h), rtol=1e-6)
+
+
+def test_train_glm_grid_warm_start_and_ordering():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(256, D))
+    w = rng.normal(size=D)
+    y = x @ w + rng.normal(scale=0.05, size=256)
+    data = DataSet.from_dense(x, y)
+    cfg = GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        optimizer_config=OptimizerConfig(tolerance=1e-12),
+    )
+    out = train_glm_grid(data, cfg, [10.0, 1.0, 0.1], dtype=jnp.float64)
+    assert len(out) == 3
+    # stronger regularization → smaller coefficient norm
+    norms = [float(jnp.linalg.norm(t.model.coefficients.means)) for t in out]
+    assert norms[0] < norms[1] < norms[2]
+    # each matches its closed form
+    for t in out:
+        expected = np.linalg.solve(
+            x.T @ x + t.regularization_weight * np.eye(D), x.T @ y
+        )
+        np.testing.assert_allclose(t.model.coefficients.means, expected, atol=1e-5)
+
+
+def test_train_glm_grid_with_normalization_matches_plain():
+    # Normalized training must land on the same original-space model.
+    rng = np.random.default_rng(2)
+    x = rng.normal(loc=3.0, scale=[1.0, 5.0, 0.2, 2.0, 1.0, 1.0], size=(300, D))
+    x[:, -1] = 1.0  # intercept
+    w = rng.normal(size=D)
+    y = x @ w + rng.normal(scale=0.05, size=300)
+    data = DataSet.from_dense(x, y)
+
+    from photon_tpu.data.stats import BasicStatisticalSummary
+
+    s = BasicStatisticalSummary.of(data)
+    ctx = NormalizationContext.build(
+        NormalizationType.STANDARDIZATION,
+        mean=s.mean,
+        variance=s.variance,
+        intercept_index=D - 1,
+        dtype=jnp.float64,
+    )
+    cfg = GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_config=OptimizerConfig(tolerance=1e-13, max_iterations=200),
+    )
+    plain = train_glm_grid(data, cfg, [0.0], dtype=jnp.float64)[0]
+    normed = train_glm_grid(
+        data, cfg, [0.0], normalization=ctx, dtype=jnp.float64
+    )[0]
+    np.testing.assert_allclose(
+        normed.model.coefficients.means,
+        plain.model.coefficients.means,
+        atol=1e-5,
+    )
