@@ -74,13 +74,31 @@ class FixedEffectCoordinate(Coordinate):
         config: FixedEffectCoordinateConfig,
         normalization: NormalizationContext = NormalizationContext(),
         dtype=jnp.float32,
+        seed: int = 0,
     ) -> "FixedEffectCoordinate":
         shard = data.feature_shards[config.feature_shard]
+        weights = data.weights
+        rate = config.optimization.down_sampling_rate
+        if 0.0 < rate < 1.0:
+            # Mask-based down-sampling: rows keep their slot (static shapes
+            # for XLA) but dropped rows get weight 0 (reference
+            # runWithSampling:145-160 drops RDD rows instead). For
+            # classification only negatives are sampled, survivors
+            # re-weighted by 1/rate so expected gradients are unchanged.
+            rng = np.random.default_rng(seed)
+            keep_draw = rng.uniform(size=data.num_samples) < rate
+            weights = weights.copy()
+            if config.optimization.task.is_classification:
+                neg = data.labels <= 0.5
+                weights[neg & ~keep_draw] = 0.0
+                weights[neg & keep_draw] /= rate
+            else:
+                weights[~keep_draw] = 0.0
         batch = LabeledBatch(
             features=jnp.asarray(shard.to_dense(), dtype=dtype),
             labels=jnp.asarray(data.labels, dtype=dtype),
             offsets=jnp.asarray(data.offsets, dtype=dtype),
-            weights=jnp.asarray(data.weights, dtype=dtype),
+            weights=jnp.asarray(weights, dtype=dtype),
         )
         problem = GLMProblem.build(
             config.optimization.with_regularization_weight(

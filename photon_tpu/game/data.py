@@ -256,7 +256,10 @@ def build_random_effect_dataset(
         rows = order[group_starts[e] : group_starts[e + 1]]
         if len(rows) < config.active_data_lower_bound:
             continue  # no model for this entity
-        # reservoir cap on *training* rows; all rows stay for scoring
+        # reservoir cap on *training* rows; passive (non-active) rows stay
+        # for scoring only when the entity has at least
+        # ``passive_data_lower_bound`` of them (reference
+        # RandomEffectDataSet passiveDataLowerBound filtering).
         active = rows
         if (
             config.active_data_upper_bound is not None
@@ -267,6 +270,9 @@ def build_random_effect_dataset(
             )
             active = rows[np.sort(sel)]
         active_set = set(active.tolist())
+        num_passive = len(rows) - len(active)
+        if 0 < num_passive < config.passive_data_lower_bound:
+            rows = active
 
         if rnd_proj is None:
             # index-compaction projection: union of active-row features
@@ -340,7 +346,8 @@ def build_random_effect_dataset(
                         if lj is not None:
                             feats[b, i, lj] = v
                 else:
-                    feats[b, i, :] = cv @ rnd_proj[ci] if len(ci) else 0.0
+                    if len(ci):
+                        feats[b, i, :d_proj] = cv @ rnd_proj[ci]
         buckets.append(
             REBucket(
                 features=feats,

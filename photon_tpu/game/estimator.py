@@ -93,6 +93,7 @@ class GameEstimator:
                     cfg,
                     norm.get(cfg.feature_shard, NormalizationContext()),
                     self.dtype,
+                    seed=self.seed,
                 )
             elif isinstance(cfg, RandomEffectCoordinateConfig):
                 ds = build_random_effect_dataset(data, cfg, seed=self.seed)
@@ -127,7 +128,6 @@ class GameEstimator:
     ) -> list[GameTrainingResult]:
         """Train one GameModel per λ-grid point, warm-starting across the
         grid (reference fit :304-390 + train :746)."""
-        t_start = time.perf_counter()
         coordinates, re_datasets = self._build_coordinates(data)
 
         init_states = None
@@ -138,7 +138,6 @@ class GameEstimator:
 
         validation_fn = None
         if validation_data is not None and self.validation_evaluator is not None:
-            transformer_datasets = {}  # score validation via cold lookup
             evaluator = self.validation_evaluator
 
             def validation_fn_impl(states):
@@ -146,12 +145,12 @@ class GameEstimator:
                 transformer = GameTransformer(model=model, task=self.task)
                 return transformer.evaluate(validation_data, evaluator)
 
-            del transformer_datasets
             validation_fn = validation_fn_impl
 
         results = []
         states = init_states
         for gi in range(self._grid_length()):
+            t_grid = time.perf_counter()
             coords_gi = {}
             reg_weights = {}
             for cid, coord in coordinates.items():
@@ -185,7 +184,7 @@ class GameEstimator:
                     evaluation=cd.best_metric,
                     regularization_weights=reg_weights,
                     tracker=cd.tracker,
-                    wall_time_s=time.perf_counter() - t_start,
+                    wall_time_s=time.perf_counter() - t_grid,
                 )
             )
             states = cd.states  # warm start the next grid point
@@ -195,10 +194,17 @@ class GameEstimator:
     # ------------------------------------------------------------------
 
     def _to_model(self, coordinates, states) -> GameModel:
+        # Include every coordinate with a state — locked coordinates outside
+        # the update sequence still contribute scores during descent and
+        # must ship with the model (reference partialRetrainLockedCoordinates).
+        ordered = list(self.update_sequence) + [
+            cid for cid in coordinates if cid not in self.update_sequence
+        ]
         return GameModel(
             coordinates={
                 cid: coordinates[cid].to_model(states[cid])
-                for cid in self.update_sequence
+                for cid in ordered
+                if cid in states
             },
             task=self.task,
         )

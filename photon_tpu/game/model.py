@@ -77,25 +77,73 @@ class RandomEffectModel:
             np.add.at(scores, bucket.sample_pos.ravel(), s.ravel())
         return scores[:n]
 
+    def _entity_coefficient_csr(self):
+        """[num_entities(+1 zero row), d] sparse coefficient matrix, cached.
+
+        Row dimension is the projected space under random projection, else
+        the global feature space. The extra last row scores unmodeled /
+        unseen entities as zero.
+        """
+        cached = getattr(self, "_coef_csr_cache", None)
+        if cached is not None:
+            return cached
+        from scipy import sparse
+
+        d = (
+            self.projection_matrix.shape[1]
+            if self.projection_matrix is not None
+            else self.num_features
+        )
+        rows, cols, vals = [], [], []
+        for b in self.buckets:
+            for i, e in enumerate(b.entity_ids):
+                w = b.coefficients[i]
+                if self.projection_matrix is not None:
+                    nz = np.flatnonzero(w)
+                    rows.extend([e] * len(nz))
+                    cols.extend(nz.tolist())
+                    vals.extend(w[nz].tolist())
+                else:
+                    cidx = b.col_index[i]
+                    valid = (cidx >= 0) & (w != 0)
+                    rows.extend([e] * int(valid.sum()))
+                    cols.extend(cidx[valid].tolist())
+                    vals.extend(w[valid].tolist())
+        csr = sparse.csr_matrix(
+            (vals, (rows, cols)), shape=(len(self.vocab) + 1, d)
+        )
+        index = {k: i for i, k in enumerate(self.vocab)}
+        object.__setattr__(self, "_coef_csr_cache", (csr, index))
+        return csr, index
+
     def score_cold(self, data: GameData) -> np.ndarray:
         """Score arbitrary data by entity lookup (unseen entities → 0),
-        the reference's scoring-time join on REId."""
+        the reference's scoring-time join on REId — vectorized as a
+        row-aligned sparse product instead of a per-sample loop."""
+        from scipy import sparse
+
         shard = data.feature_shards[self.feature_shard]
         keys = data.id_tags[self.random_effect_type]
-        entity_vec = self.dense_coefficient_lookup()
-        index = {k: i for i, k in enumerate(self.vocab)}
-        scores = np.zeros(data.num_samples)
-        for r in range(data.num_samples):
-            e = index.get(keys[r])
-            if e is None or entity_vec[e] is None:
-                continue
-            ci, cv = shard.row(r)
-            if self.projection_matrix is not None:
-                proj = cv @ self.projection_matrix[ci] if len(ci) else 0.0
-                scores[r] = float(np.dot(proj, entity_vec[e]))
-            else:
-                scores[r] = float(entity_vec[e][ci] @ cv)
-        return scores
+        coef_csr, index = self._entity_coefficient_csr()
+        zero_row = len(self.vocab)
+        entity_per_row = np.fromiter(
+            (index.get(k, zero_row) for k in keys),
+            dtype=np.int64,
+            count=len(keys),
+        )
+        x = sparse.csr_matrix(
+            (shard.values, shard.indices, shard.indptr),
+            shape=(shard.num_rows, shard.num_cols),
+        )
+        if self.projection_matrix is not None:
+            x_eff = np.asarray(x @ self.projection_matrix)
+            per_row_coef = np.asarray(
+                coef_csr[entity_per_row].todense()
+            )
+            return np.einsum("nd,nd->n", x_eff, per_row_coef)
+        return np.asarray(
+            x.multiply(coef_csr[entity_per_row]).sum(axis=1)
+        ).ravel()
 
     def dense_coefficient_lookup(self) -> list:
         """entity dense-index → global-space coefficient vector (or

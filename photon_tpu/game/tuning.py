@@ -1,0 +1,143 @@
+"""GAME hyperparameter tuning glue: vectorize a GAME config ↔ [0,1]^d and run
+one full training per candidate.
+
+Reference parity: photon-client estimators/GameEstimatorEvaluationFunction
+.scala:52-170 (regularization weights searched on log10 scale, one dimension
+per tunable coordinate in update-sequence order) and
+GameTrainingDriver.runHyperparameterTuning (GameTrainingDriver.scala:631-668).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from photon_tpu.game.data import GameData
+from photon_tpu.game.estimator import GameEstimator, GameTrainingResult
+from photon_tpu.hyperparameter.evaluation import (
+    EvaluationFunction,
+    HyperparameterScale,
+    rescale_backward,
+    rescale_forward,
+)
+from photon_tpu.hyperparameter.search import (
+    GaussianProcessSearch,
+    RandomSearch,
+)
+
+# Default search range for regularization weights, log10 scale (reference
+# GameEstimatorEvaluationFunction: weights tuned in log space).
+DEFAULT_REG_RANGE = (1e-4, 1e4)
+
+
+class GameEstimatorEvaluationFunction(EvaluationFunction[GameTrainingResult]):
+    """Evaluates one hyperparameter candidate = one GAME training run.
+
+    The candidate vector holds one [0,1] value per tunable coordinate
+    (update-sequence order), mapped onto the coordinate's regularization
+    weight on log10 scale.
+    """
+
+    def __init__(
+        self,
+        estimator: GameEstimator,
+        train_data: GameData,
+        validation_data: GameData,
+        reg_ranges: Mapping[str, tuple[float, float]] | None = None,
+        tunable_coordinates: Sequence[str] | None = None,
+    ):
+        if estimator.validation_evaluator is None:
+            raise ValueError("tuning requires a validation evaluator")
+        self.estimator = estimator
+        self.train_data = train_data
+        self.validation_data = validation_data
+        self.tunable = list(
+            tunable_coordinates
+            if tunable_coordinates is not None
+            else [
+                c
+                for c in estimator.update_sequence
+                if c not in estimator.locked_coordinates
+            ]
+        )
+        ranges = reg_ranges or {}
+        self.ranges = [
+            (*ranges.get(cid, DEFAULT_REG_RANGE), HyperparameterScale.LOG)
+            for cid in self.tunable
+        ]
+
+    @property
+    def num_params(self) -> int:
+        return len(self.tunable)
+
+    def candidate_to_weights(self, candidate: np.ndarray) -> dict[str, float]:
+        reg = rescale_backward(np.asarray(candidate, float), self.ranges)
+        return dict(zip(self.tunable, reg))
+
+    def weights_to_candidate(self, weights: Mapping[str, float]) -> np.ndarray:
+        vals = np.array([weights[cid] for cid in self.tunable])
+        return rescale_forward(vals, self.ranges)
+
+    def __call__(self, candidate: np.ndarray):
+        weights = self.candidate_to_weights(candidate)
+        configs = {
+            cid: dataclasses.replace(
+                cfg,
+                regularization_weights=(
+                    (weights[cid],) if cid in weights
+                    else cfg.regularization_weights
+                ),
+            )
+            for cid, cfg in self.estimator.coordinate_configs.items()
+        }
+        estimator = dataclasses.replace(
+            self.estimator, coordinate_configs=configs
+        )
+        results = estimator.fit(
+            self.train_data, validation_data=self.validation_data
+        )
+        result = results[-1]
+        assert result.evaluation is not None
+        return float(result.evaluation), result
+
+    def convert_observations(self, results):
+        out = []
+        for r in results:
+            out.append(
+                (
+                    self.weights_to_candidate(r.regularization_weights),
+                    float(r.evaluation),
+                )
+            )
+        return out
+
+
+def run_hyperparameter_tuning(
+    estimator: GameEstimator,
+    train_data: GameData,
+    validation_data: GameData,
+    *,
+    num_iterations: int,
+    mode: str = "BAYESIAN",
+    reg_ranges: Mapping[str, tuple[float, float]] | None = None,
+    prior_observations: Sequence[tuple[np.ndarray, float]] = (),
+    seed: int = 0,
+) -> list[GameTrainingResult]:
+    """Bayesian or random search over regularization weights (reference
+    GameTrainingDriver.runHyperparameterTuning :631-668)."""
+    fn = GameEstimatorEvaluationFunction(
+        estimator, train_data, validation_data, reg_ranges
+    )
+    maximize = estimator.validation_evaluator.larger_is_better
+    if mode.upper() == "BAYESIAN":
+        search: RandomSearch = GaussianProcessSearch(
+            fn.num_params, fn, seed=seed, maximize=maximize
+        )
+    elif mode.upper() == "RANDOM":
+        search = RandomSearch(fn.num_params, fn, seed=seed, maximize=maximize)
+    else:
+        raise ValueError(f"unknown tuning mode {mode!r}")
+    return search.find_with_prior_observations(
+        num_iterations, list(prior_observations)
+    )

@@ -1,0 +1,44 @@
+"""Hyperparameter tuning: Bayesian (GP + slice sampling + EI/CB) and random
+search over a unit hypercube of rescaled hyperparameters.
+
+TPU-native counterpart of the reference hyperparameter subsystem
+(photon-lib hyperparameter/: SliceSampler.scala, estimators/, criteria/,
+search/). The GP bookkeeping runs host-side on numpy/scipy by design: the
+kernel matrices are tiny (one row per completed training run), while each
+candidate evaluation is a full GAME training run on the TPU mesh — the same
+split the reference uses (Breeze on the Spark driver, training on executors).
+"""
+from photon_tpu.hyperparameter.kernels import RBF, Matern52, StationaryKernel
+from photon_tpu.hyperparameter.slice_sampler import SliceSampler
+from photon_tpu.hyperparameter.gp import (
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+)
+from photon_tpu.hyperparameter.criteria import (
+    confidence_bound,
+    expected_improvement,
+)
+from photon_tpu.hyperparameter.search import GaussianProcessSearch, RandomSearch
+from photon_tpu.hyperparameter.evaluation import (
+    EvaluationFunction,
+    HyperparameterScale,
+    rescale_backward,
+    rescale_forward,
+)
+
+__all__ = [
+    "RBF",
+    "Matern52",
+    "StationaryKernel",
+    "SliceSampler",
+    "GaussianProcessEstimator",
+    "GaussianProcessModel",
+    "expected_improvement",
+    "confidence_bound",
+    "RandomSearch",
+    "GaussianProcessSearch",
+    "EvaluationFunction",
+    "HyperparameterScale",
+    "rescale_forward",
+    "rescale_backward",
+]

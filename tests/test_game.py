@@ -223,3 +223,109 @@ def test_validation_tracking_selects_best():
     result = est.fit(data, validation_data=val_data)[0]
     assert result.evaluation is not None
     assert 0.0 <= result.evaluation <= 1.0
+
+
+def test_random_projection_non_power_of_two_dim():
+    """Regression: RANDOM projector with a non-pow2 dim must not crash and
+    must score consistently between bucket and cold paths."""
+    from photon_tpu.game.config import ProjectorType
+    import dataclasses as dc
+
+    data, *_ = _make_game_data(seed=6, n=300)
+    cfg = dc.replace(
+        _configs()["per-user"],
+        projector_type=ProjectorType.RANDOM,
+        random_projection_dim=5,
+    )
+    ds = build_random_effect_dataset(data, cfg)
+    assert ds.projection_matrix.shape == (D_RE, 5)
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={"fixed": _configs()["fixed"], "per-user": cfg},
+        update_sequence=["fixed", "per-user"],
+        dtype=jnp.float64,
+    )
+    model = est.fit(data)[0].model
+    re_model = model["per-user"]
+    via_buckets = re_model.score(data, build_random_effect_dataset(data, cfg))
+    via_lookup = re_model.score_cold(data)
+    np.testing.assert_allclose(via_buckets, via_lookup, atol=1e-5)
+
+
+def test_passive_data_lower_bound_drops_scoring_rows():
+    """Entities whose passive-row count is below the bound keep only their
+    active rows (reference passiveDataLowerBound)."""
+    import dataclasses as dc
+
+    data, *_ = _make_game_data(seed=7, n=400)
+    base = _configs()["per-user"]
+    capped = dc.replace(base, active_data_upper_bound=5)
+    with_bound = dc.replace(capped, passive_data_lower_bound=10**9)
+    ds_plain = build_random_effect_dataset(data, capped)
+    ds_bound = build_random_effect_dataset(data, with_bound)
+    rows_plain = sum(
+        int((b.sample_pos < data.num_samples).sum()) for b in ds_plain.buckets
+    )
+    rows_bound = sum(
+        int((b.sample_pos < data.num_samples).sum()) for b in ds_bound.buckets
+    )
+    assert rows_bound < rows_plain
+    # active rows all survive: every entity keeps >= min(count, cap)
+    assert rows_bound == sum(
+        min(int(c), 5)
+        for c in np.unique(
+            data.id_tags["userId"], return_counts=True
+        )[1]
+    )
+
+
+def test_fixed_effect_down_sampling_applies_weight_mask():
+    """down_sampling_rate < 1 zeroes dropped negatives and re-weights kept
+    ones on the fixed-effect coordinate (reference runWithSampling)."""
+    import dataclasses as dc
+
+    from photon_tpu.game.coordinate import FixedEffectCoordinate
+
+    data, *_ = _make_game_data(seed=8, n=500, task="logistic")
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION, down_sampling_rate=0.5
+    )
+    cfg = FixedEffectCoordinateConfig(
+        feature_shard="global", optimization=opt,
+        regularization_weights=(1.0,),
+    )
+    coord = FixedEffectCoordinate.build(data, cfg, seed=1)
+    w = np.asarray(coord.batch.weights)
+    labels = np.asarray(coord.batch.labels)
+    neg = labels <= 0.5
+    assert np.all(w[~neg] == 1.0)  # positives untouched
+    assert np.any(w[neg] == 0.0)  # some negatives dropped
+    kept = w[neg][w[neg] > 0]
+    np.testing.assert_allclose(kept, 2.0)  # 1/rate re-weighting
+
+
+def test_locked_coordinate_outside_update_sequence_kept_in_model():
+    """A locked coordinate not listed in the update sequence still ships
+    with the trained model (its scores shaped every residual)."""
+    data, *_ = _make_game_data(seed=9)
+    cfgs = _configs()
+    base = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=cfgs,
+        update_sequence=["fixed", "per-user"],
+        dtype=jnp.float64,
+    ).fit(data)[0].model
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=cfgs,
+        update_sequence=["per-user"],
+        locked_coordinates=frozenset({"fixed"}),
+        dtype=jnp.float64,
+    )
+    out = est.fit(data, initial_model=base)[0].model
+    assert "fixed" in out.coordinates
+    np.testing.assert_allclose(
+        out["fixed"].model.coefficients.means,
+        base["fixed"].model.coefficients.means,
+        rtol=1e-12,
+    )

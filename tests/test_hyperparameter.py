@@ -1,0 +1,227 @@
+"""Hyperparameter subsystem tests (kernels, slice sampler, GP, search).
+
+Mirrors the reference unit tests (photon-lib src/test hyperparameter/):
+kernel values vs closed forms, sampler distribution checks, GP posterior
+recovery, and search loop behavior.
+"""
+import numpy as np
+import pytest
+
+from photon_tpu.hyperparameter import (
+    GaussianProcessEstimator,
+    GaussianProcessSearch,
+    HyperparameterScale,
+    Matern52,
+    RBF,
+    RandomSearch,
+    SliceSampler,
+    confidence_bound,
+    expected_improvement,
+    rescale_backward,
+    rescale_forward,
+)
+from photon_tpu.hyperparameter.evaluation import CallableEvaluationFunction
+
+
+class TestKernels:
+    def test_rbf_diagonal_is_amplitude_plus_noise(self):
+        k = RBF(amplitude=2.0, noise=0.1, length_scale=np.ones(3))
+        x = np.random.default_rng(0).normal(size=(5, 3))
+        cov = k.train_covariance(x)
+        np.testing.assert_allclose(np.diag(cov), 2.1)
+
+    def test_rbf_closed_form(self):
+        k = RBF(amplitude=1.0, noise=0.0, length_scale=np.ones(1))
+        x = np.array([[0.0], [1.0]])
+        cov = k.train_covariance(x)
+        assert cov[0, 1] == pytest.approx(np.exp(-0.5))
+
+    def test_matern52_closed_form(self):
+        k = Matern52(amplitude=1.0, noise=0.0, length_scale=np.ones(1))
+        x = np.array([[0.0], [2.0]])
+        r2 = 4.0
+        f = np.sqrt(5 * r2)
+        expected = (1 + f + 5 * r2 / 3) * np.exp(-f)
+        assert k.train_covariance(x)[0, 1] == pytest.approx(expected)
+
+    def test_kernel_psd(self):
+        x = np.random.default_rng(1).normal(size=(20, 4))
+        for k in (RBF(), Matern52()):
+            eigs = np.linalg.eigvalsh(k.train_covariance(x))
+            assert np.all(eigs > 0)
+
+    def test_anisotropic_length_scale(self):
+        k = RBF(length_scale=np.array([1.0, 100.0]))
+        x = np.array([[0.0, 0.0], [0.0, 50.0]])
+        # Distance along the long-length-scale dim barely decorrelates.
+        assert k.cross_covariance(x[:1], x[1:])[0, 0] > 0.8
+
+    def test_log_likelihood_rejects_out_of_prior(self):
+        x = np.random.default_rng(2).normal(size=(5, 2))
+        y = np.random.default_rng(3).normal(size=5)
+        assert Matern52(amplitude=-1.0).log_likelihood(x, y) == -np.inf
+        assert (
+            Matern52(length_scale=np.array([3.0, 1.0])).log_likelihood(x, y)
+            == -np.inf
+        )
+
+    def test_log_likelihood_prefers_true_length_scale(self):
+        rng = np.random.default_rng(4)
+        x = rng.uniform(0, 1, size=(30, 1))
+        y = np.sin(4 * np.pi * x[:, 0])
+        good = Matern52(noise=1e-4, length_scale=np.array([0.2]))
+        bad = Matern52(noise=1e-4, length_scale=np.array([1.9]))
+        assert good.log_likelihood(x, y) > bad.log_likelihood(x, y)
+
+    def test_theta_roundtrip(self):
+        k = Matern52(amplitude=2.0, noise=0.5, length_scale=np.array([1.0, 0.3]))
+        k2 = Matern52().with_theta(k.theta)
+        assert k2.amplitude == 2.0 and k2.noise == 0.5
+        np.testing.assert_allclose(k2.length_scale, [1.0, 0.3])
+
+
+class TestSliceSampler:
+    def test_samples_standard_normal(self):
+        logp = lambda v: -0.5 * float(v @ v)
+        sampler = SliceSampler(seed=0)
+        x = np.zeros(1)
+        draws = []
+        for _ in range(2000):
+            x = sampler.draw(x, logp)
+            draws.append(x[0])
+        draws = np.asarray(draws[200:])
+        assert abs(np.mean(draws)) < 0.15
+        assert abs(np.std(draws) - 1.0) < 0.15
+
+    def test_dimension_wise_respects_support(self):
+        # Uniform on [0, 1]^2: all samples must stay inside.
+        logp = lambda v: 0.0 if np.all((v >= 0) & (v <= 1)) else -np.inf
+        sampler = SliceSampler(seed=1)
+        x = np.full(2, 0.5)
+        for _ in range(100):
+            x = sampler.draw_dimension_wise(x, logp)
+            assert np.all((x >= 0) & (x <= 1))
+
+
+class TestGaussianProcess:
+    def test_posterior_interpolates_noiseless(self):
+        rng = np.random.default_rng(0)
+        x = rng.uniform(0, 1, size=(12, 1))
+        y = np.sin(2 * np.pi * x[:, 0])
+        model = GaussianProcessEstimator(
+            kernel=Matern52(), burn_in_samples=20, num_samples=5, seed=0
+        ).fit(x, y)
+        means, variances = model.predict(x)
+        np.testing.assert_allclose(means, y, atol=0.1)
+        assert np.all(variances < 0.1)
+
+    def test_variance_grows_off_data(self):
+        x = np.linspace(0.4, 0.6, 8)[:, None]
+        y = np.zeros(8)
+        model = GaussianProcessEstimator(
+            burn_in_samples=20, num_samples=5, seed=0
+        ).fit(x, y)
+        _, var_near = model.predict(np.array([[0.5]]))
+        _, var_far = model.predict(np.array([[0.0]]))
+        assert var_far[0] > var_near[0]
+
+    def test_normalize_labels(self):
+        x = np.linspace(0, 1, 10)[:, None]
+        y = 5.0 + 0.0 * x[:, 0]
+        model = GaussianProcessEstimator(
+            normalize_labels=True, burn_in_samples=10, num_samples=3, seed=0
+        ).fit(x, y)
+        assert model.y_mean == pytest.approx(5.0)
+        means, _ = model.predict(np.array([[0.5]]))
+        assert means[0] == pytest.approx(5.0, abs=0.2)
+
+
+class TestCriteria:
+    def test_expected_improvement_positive_and_monotone(self):
+        ei = expected_improvement(best_evaluation=1.0, maximize=True)
+        means = np.array([0.0, 1.0, 2.0])
+        variances = np.full(3, 0.25)
+        vals = ei(means, variances)
+        assert np.all(vals >= 0)
+        assert vals[2] > vals[1] > vals[0]
+
+    def test_expected_improvement_minimize_direction(self):
+        ei = expected_improvement(best_evaluation=1.0, maximize=False)
+        vals = ei(np.array([0.0, 2.0]), np.full(2, 0.25))
+        assert vals[0] > vals[1]
+
+    def test_confidence_bound(self):
+        ucb = confidence_bound(exploration_factor=2.0, maximize=True)
+        lcb = confidence_bound(exploration_factor=2.0, maximize=False)
+        means = np.array([1.0])
+        variances = np.array([4.0])
+        assert ucb(means, variances)[0] == pytest.approx(5.0)
+        assert lcb(means, variances)[0] == pytest.approx(-3.0)
+
+
+class TestRescaling:
+    def test_roundtrip(self):
+        ranges = [
+            (1e-4, 1e2, HyperparameterScale.LOG),
+            (0.0, 1.0, HyperparameterScale.LINEAR),
+        ]
+        values = np.array([0.5, 0.25])
+        unit = rescale_forward(values, ranges)
+        back = rescale_backward(unit, ranges)
+        np.testing.assert_allclose(back, values, rtol=1e-12)
+        assert np.all((unit >= 0) & (unit <= 1))
+
+    def test_log_midpoint(self):
+        ranges = [(1e-2, 1e2, HyperparameterScale.LOG)]
+        back = rescale_backward(np.array([0.5]), ranges)
+        assert back[0] == pytest.approx(1.0)
+
+
+class TestSearch:
+    def test_random_search_returns_n_results(self):
+        fn = CallableEvaluationFunction(lambda c: -float(np.sum(c**2)))
+        search = RandomSearch(num_params=3, evaluation_function=fn, seed=0)
+        results = search.find(5)
+        assert len(results) == 5
+        for vec, _ in results:
+            assert vec.shape == (3,)
+            assert np.all((vec >= 0) & (vec <= 1))
+
+    def test_discretization(self):
+        fn = CallableEvaluationFunction(lambda c: 0.0)
+        search = RandomSearch(
+            num_params=2,
+            evaluation_function=fn,
+            discrete_params={0: 4},
+            seed=0,
+        )
+        results = search.find(8)
+        for vec, _ in results:
+            assert vec[0] in {0.0, 0.25, 0.5, 0.75}
+
+    def test_gp_search_beats_random_on_quadratic(self):
+        target = np.array([0.3, 0.7])
+
+        def objective(c):
+            return -float(np.sum((c - target) ** 2))
+
+        def best_of(search_cls, **kw):
+            fn = CallableEvaluationFunction(objective)
+            s = search_cls(num_params=2, evaluation_function=fn, seed=3, **kw)
+            results = s.find(12)
+            return max(v for _, v in results)
+
+        gp_best = best_of(GaussianProcessSearch, candidate_pool_size=100)
+        assert gp_best > -0.05  # near the optimum
+
+    def test_gp_search_with_priors(self):
+        fn = CallableEvaluationFunction(lambda c: -float(np.sum(c**2)))
+        s = GaussianProcessSearch(
+            num_params=2, evaluation_function=fn, seed=0,
+            candidate_pool_size=50,
+        )
+        priors = [(np.array([0.9, 0.9]), -1.5), (np.array([0.1, 0.1]), -0.01)]
+        results = s.find_with_priors(
+            3, [(np.array([0.5, 0.5]), -0.5)], priors
+        )
+        assert len(results) == 3
