@@ -37,6 +37,7 @@ from photon_tpu.game.model import (
 )
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import model_for_task
+from photon_tpu.ops.losses import POSITIVE_RESPONSE_THRESHOLD
 from photon_tpu.ops.normalization import NormalizationContext
 from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
 from photon_tpu.types import Array, LabeledBatch
@@ -87,9 +88,9 @@ class FixedEffectCoordinate(Coordinate):
             # re-weighted by 1/rate so expected gradients are unchanged.
             rng = np.random.default_rng(seed)
             keep_draw = rng.uniform(size=data.num_samples) < rate
-            weights = weights.copy()
+            weights = np.asarray(weights, dtype=np.float64).copy()
             if config.optimization.task.is_classification:
-                neg = data.labels <= 0.5
+                neg = data.labels <= POSITIVE_RESPONSE_THRESHOLD
                 weights[neg & ~keep_draw] = 0.0
                 weights[neg & keep_draw] /= rate
             else:

@@ -78,6 +78,7 @@ class GameData:
     weights: np.ndarray
     feature_shards: Mapping[str, CSRMatrix]
     id_tags: Mapping[str, np.ndarray]  # tag → [N] array of entity keys
+    uids: Sequence[str | None] | None = None  # per-sample ids (score output)
 
     def __post_init__(self):
         n = self.num_samples
@@ -87,6 +88,8 @@ class GameData:
         for tag, col in self.id_tags.items():
             if len(col) != n:
                 raise ValueError(f"id tag {tag} has {len(col)} rows != {n}")
+        if self.uids is not None and len(self.uids) != n:
+            raise ValueError(f"uids has {len(self.uids)} rows != {n}")
 
     @property
     def num_samples(self) -> int:
@@ -100,6 +103,7 @@ class GameData:
         offsets: np.ndarray | None = None,
         weights: np.ndarray | None = None,
         id_tags: Mapping[str, Sequence] | None = None,
+        uids: Sequence[str | None] | None = None,
     ) -> "GameData":
         n = len(labels)
         return GameData(
@@ -110,6 +114,7 @@ class GameData:
             id_tags={
                 t: np.asarray(v) for t, v in (id_tags or {}).items()
             },
+            uids=uids,
         )
 
 
@@ -270,8 +275,10 @@ def build_random_effect_dataset(
             )
             active = rows[np.sort(sel)]
         active_set = set(active.tolist())
+        # strict '>' to keep passive rows, matching the reference's
+        # `.filter(_._2 > passiveDataLowerBound)`
         num_passive = len(rows) - len(active)
-        if 0 < num_passive < config.passive_data_lower_bound:
+        if 0 < num_passive <= config.passive_data_lower_bound:
             rows = active
 
         if rnd_proj is None:

@@ -1,0 +1,30 @@
+"""IO: Avro codec, schemas, data readers, and model persistence.
+
+TPU-native counterpart of photon-client data/avro/ (AvroDataReader,
+ModelProcessingUtils, AvroUtils) and photon-avro-schemas. The binary Avro
+codec is pure Python (the image has no fastavro); the container-file format
+is wire-compatible so saved datasets/models interop with JVM Avro tooling.
+"""
+from photon_tpu.io.avro import read_avro_file, write_avro_file
+from photon_tpu.io import schemas
+from photon_tpu.io.data_reader import AvroDataReader, FeatureShardConfig
+from photon_tpu.io.model_io import (
+    load_game_model,
+    load_glm,
+    save_game_model,
+    save_glm,
+    save_scoring_results,
+)
+
+__all__ = [
+    "read_avro_file",
+    "write_avro_file",
+    "schemas",
+    "AvroDataReader",
+    "FeatureShardConfig",
+    "save_game_model",
+    "load_game_model",
+    "save_glm",
+    "load_glm",
+    "save_scoring_results",
+]

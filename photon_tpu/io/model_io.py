@@ -1,0 +1,463 @@
+"""GAME / GLM model persistence in the reference's on-disk format.
+
+Reference parity: photon-client data/avro/ModelProcessingUtils.scala —
+layout ``<dir>/model-metadata.json``,
+``<dir>/fixed-effect/<coordinate>/{id-info, coefficients/part-00000.avro}``,
+``<dir>/random-effect/<coordinate>/{id-info, coefficients/part-*.avro}``
+(:75-140, saveGameModelMetadataToHDFS :493), with coefficients stored as
+``BayesianLinearModelAvro`` records of (name, term, value) means/variances
+and coefficients below the sparsity threshold dropped
+(VectorUtils.DEFAULT_SPARSITY_THRESHOLD = 1e-4). ScoringResultAvro output
+mirrors ScoreProcessingUtils.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.index_map import INTERSECT, IndexMap
+from photon_tpu.game.model import (
+    BucketCoefficients,
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.io import schemas
+from photon_tpu.io.avro import read_avro_dir, read_avro_file, write_avro_file
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.glm import GeneralizedLinearModel, model_for_task
+from photon_tpu.types import TaskType
+
+SPARSITY_THRESHOLD = 1e-4
+FIXED_EFFECT = "fixed-effect"
+RANDOM_EFFECT = "random-effect"
+ID_INFO = "id-info"
+COEFFICIENTS = "coefficients"
+DEFAULT_AVRO_FILE = "part-00000.avro"
+METADATA_FILE = "model-metadata.json"
+
+# Reference model-class strings (BayesianLinearModelAvro.modelClass) so
+# saved models name the same classes the JVM implementation writes.
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_TO_TASK = {v: k for k, v in _MODEL_CLASS.items()}
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    name, sep, term = key.partition(INTERSECT)
+    return name, term
+
+
+def _vector_to_ntv(
+    vec: np.ndarray,
+    index_map: IndexMap,
+    threshold: float,
+) -> list[dict]:
+    out = []
+    for i in np.flatnonzero(np.abs(vec) > threshold):
+        key = index_map.get_feature_name(int(i))
+        if key is None:
+            continue
+        name, term = _split_key(key)
+        out.append({"name": name, "term": term, "value": float(vec[i])})
+    return out
+
+
+def _ntv_to_vector(items: Sequence[dict], index_map: IndexMap) -> np.ndarray:
+    vec = np.zeros(len(index_map))
+    for item in items:
+        idx = index_map.get_index(
+            f"{item['name']}{INTERSECT}{item.get('term') or ''}"
+        )
+        if idx >= 0:
+            vec[idx] = float(item["value"])
+    return vec
+
+
+def _glm_record(
+    model_id: str,
+    means: np.ndarray,
+    variances: np.ndarray | None,
+    task: TaskType,
+    index_map: IndexMap,
+    threshold: float,
+) -> dict:
+    return {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS.get(task),
+        "means": _vector_to_ntv(np.asarray(means), index_map, threshold),
+        "variances": (
+            None
+            if variances is None
+            else _vector_to_ntv(np.asarray(variances), index_map, -np.inf)
+        ),
+        "lossFunction": None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# single GLM (legacy driver path)
+# ---------------------------------------------------------------------------
+
+
+def save_glm(
+    path: str | os.PathLike,
+    model: GeneralizedLinearModel,
+    task: TaskType,
+    index_map: IndexMap,
+    *,
+    model_id: str = "",
+    sparsity_threshold: float = SPARSITY_THRESHOLD,
+) -> None:
+    """One BayesianLinearModelAvro record to one container file."""
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    coefs = model.coefficients
+    rec = _glm_record(
+        model_id,
+        np.asarray(coefs.means),
+        None if coefs.variances is None else np.asarray(coefs.variances),
+        task,
+        index_map,
+        sparsity_threshold,
+    )
+    write_avro_file(path, schemas.BAYESIAN_LINEAR_MODEL_AVRO, [rec])
+
+
+def load_glm(
+    path: str | os.PathLike, index_map: IndexMap
+) -> tuple[GeneralizedLinearModel, TaskType | None]:
+    records = read_avro_file(path)
+    if len(records) != 1:
+        raise ValueError(f"{path}: expected 1 model record, got {len(records)}")
+    rec = records[0]
+    means = _ntv_to_vector(rec["means"], index_map)
+    variances = (
+        _ntv_to_vector(rec["variances"], index_map)
+        if rec.get("variances")
+        else None
+    )
+    task = _CLASS_TO_TASK.get(rec.get("modelClass"))
+    coefs = Coefficients(
+        means=jnp.asarray(means),
+        variances=None if variances is None else jnp.asarray(variances),
+    )
+    model = model_for_task(task or TaskType.LINEAR_REGRESSION, coefs)
+    return model, task
+
+
+# ---------------------------------------------------------------------------
+# GAME model save/load
+# ---------------------------------------------------------------------------
+
+
+def save_game_model(
+    out_dir: str | os.PathLike,
+    model: GameModel,
+    index_maps: Mapping[str, IndexMap],
+    *,
+    optimization_configurations: Mapping | None = None,
+    sparsity_threshold: float = SPARSITY_THRESHOLD,
+    random_effect_records_per_file: int = 10000,
+) -> None:
+    """Write the reference per-coordinate directory tree."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / METADATA_FILE).write_text(
+        json.dumps(
+            {
+                "modelType": model.task.name,
+                "optimizationConfigurations": dict(
+                    optimization_configurations or {}
+                ),
+            },
+            indent=2,
+        )
+    )
+
+    for cid, coord_model in model.coordinates.items():
+        if isinstance(coord_model, FixedEffectModel):
+            d = out / FIXED_EFFECT / cid
+            (d / COEFFICIENTS).mkdir(parents=True, exist_ok=True)
+            (d / ID_INFO).write_text(coord_model.feature_shard + "\n")
+            imap = index_maps[coord_model.feature_shard]
+            coefs = coord_model.model.coefficients
+            rec = _glm_record(
+                cid,
+                np.asarray(coefs.means),
+                None if coefs.variances is None else np.asarray(coefs.variances),
+                model.task,
+                imap,
+                sparsity_threshold,
+            )
+            write_avro_file(
+                d / COEFFICIENTS / DEFAULT_AVRO_FILE,
+                schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                [rec],
+            )
+        elif isinstance(coord_model, RandomEffectModel):
+            d = out / RANDOM_EFFECT / cid
+            (d / COEFFICIENTS).mkdir(parents=True, exist_ok=True)
+            (d / ID_INFO).write_text(
+                coord_model.random_effect_type
+                + "\n"
+                + coord_model.feature_shard
+                + "\n"
+            )
+            if coord_model.projection_matrix is not None:
+                np.save(
+                    d / "projection-matrix.npy", coord_model.projection_matrix
+                )
+            imap = index_maps[coord_model.feature_shard]
+            records = _random_effect_records(
+                coord_model, imap, sparsity_threshold
+            )
+            part = 0
+            for start in range(
+                0, max(len(records), 1), random_effect_records_per_file
+            ):
+                chunk = records[start : start + random_effect_records_per_file]
+                write_avro_file(
+                    d / COEFFICIENTS / f"part-{part:05d}.avro",
+                    schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                    chunk,
+                )
+                part += 1
+        else:
+            raise TypeError(f"unknown coordinate model for {cid}")
+
+
+def _random_effect_records(
+    model: RandomEffectModel, index_map: IndexMap, threshold: float
+) -> list[dict]:
+    records = []
+    for b in model.buckets:
+        for i, e in enumerate(b.entity_ids):
+            w = np.asarray(b.coefficients[i])
+            entity_key = str(model.vocab[e])
+            if model.projection_matrix is not None:
+                # store projected-space coefficients positionally
+                means = [
+                    {"name": str(j), "term": "", "value": float(w[j])}
+                    for j in np.flatnonzero(np.abs(w) > threshold)
+                ]
+                records.append(
+                    {
+                        "modelId": entity_key,
+                        "modelClass": _MODEL_CLASS.get(model.task),
+                        "means": means,
+                        "variances": None,
+                        "lossFunction": None,
+                    }
+                )
+                continue
+            cols = b.col_index[i]
+            valid = (cols >= 0) & (np.abs(w) > threshold)
+            means = []
+            for j in np.flatnonzero(valid):
+                key = index_map.get_feature_name(int(cols[j]))
+                if key is None:
+                    continue
+                name, term = _split_key(key)
+                means.append({"name": name, "term": term, "value": float(w[j])})
+            variances = None
+            if b.variances is not None:
+                v = np.asarray(b.variances[i])
+                variances = []
+                for j in np.flatnonzero(valid):
+                    key = index_map.get_feature_name(int(cols[j]))
+                    if key is None:
+                        continue
+                    name, term = _split_key(key)
+                    variances.append(
+                        {"name": name, "term": term, "value": float(v[j])}
+                    )
+            records.append(
+                {
+                    "modelId": entity_key,
+                    "modelClass": _MODEL_CLASS.get(model.task),
+                    "means": means,
+                    "variances": variances,
+                    "lossFunction": None,
+                }
+            )
+    return records
+
+
+def load_game_model(
+    model_dir: str | os.PathLike,
+    index_maps: Mapping[str, IndexMap],
+) -> GameModel:
+    """Load the per-coordinate directory tree back into a GameModel."""
+    out = Path(model_dir)
+    meta = json.loads((out / METADATA_FILE).read_text())
+    task = TaskType[meta["modelType"]]
+
+    coordinates: dict = {}
+    fixed_dir = out / FIXED_EFFECT
+    if fixed_dir.is_dir():
+        for cdir in sorted(fixed_dir.iterdir()):
+            if not cdir.is_dir():
+                continue
+            shard = (cdir / ID_INFO).read_text().strip().splitlines()[0]
+            imap = index_maps[shard]
+            model, _ = load_glm(cdir / COEFFICIENTS / DEFAULT_AVRO_FILE, imap)
+            glm = model_for_task(task, model.coefficients)
+            coordinates[cdir.name] = FixedEffectModel(
+                model=glm, feature_shard=shard
+            )
+
+    re_dir = out / RANDOM_EFFECT
+    if re_dir.is_dir():
+        for cdir in sorted(re_dir.iterdir()):
+            if not cdir.is_dir():
+                continue
+            lines = (cdir / ID_INFO).read_text().strip().splitlines()
+            re_type, shard = lines[0], lines[1]
+            imap = index_maps[shard]
+            proj = None
+            proj_path = cdir / "projection-matrix.npy"
+            if proj_path.exists():
+                proj = np.load(proj_path)
+            records = list(read_avro_dir(cdir / COEFFICIENTS))
+            coordinates[cdir.name] = _records_to_random_effect_model(
+                records, re_type, shard, task, imap, proj
+            )
+
+    return GameModel(coordinates=coordinates, task=task)
+
+
+def _records_to_random_effect_model(
+    records: list[dict],
+    re_type: str,
+    shard: str,
+    task: TaskType,
+    index_map: IndexMap,
+    projection_matrix: np.ndarray | None,
+) -> RandomEffectModel:
+    """Rebuild the bucketed TPU layout from per-entity records: entities are
+    re-grouped into power-of-two-width buckets of their (sparse) support."""
+    vocab = np.array(sorted(str(r["modelId"]) for r in records))
+    entity_index = {k: i for i, k in enumerate(vocab)}
+
+    per_entity: list[tuple[int, np.ndarray, np.ndarray, np.ndarray | None]] = []
+    for r in records:
+        e = entity_index[str(r["modelId"])]
+        if projection_matrix is not None:
+            d_proj = projection_matrix.shape[1]
+            w = np.zeros(d_proj)
+            for item in r["means"]:
+                w[int(item["name"])] = float(item["value"])
+            per_entity.append((e, np.arange(d_proj), w, None))
+            continue
+        cols, vals = [], []
+        for item in r["means"]:
+            idx = index_map.get_index(
+                f"{item['name']}{INTERSECT}{item.get('term') or ''}"
+            )
+            if idx >= 0:
+                cols.append(idx)
+                vals.append(float(item["value"]))
+        var = None
+        if r.get("variances"):
+            vmap = {}
+            for item in r["variances"]:
+                idx = index_map.get_index(
+                    f"{item['name']}{INTERSECT}{item.get('term') or ''}"
+                )
+                if idx >= 0:
+                    vmap[idx] = float(item["value"])
+            var = np.array([vmap.get(c, 0.0) for c in cols])
+        per_entity.append(
+            (e, np.asarray(cols, dtype=np.int64), np.asarray(vals), var)
+        )
+
+    def _ceil_pow2(n: int, floor: int = 1) -> int:
+        p = floor
+        while p < n:
+            p *= 2
+        return p
+
+    groups: dict[int, list] = {}
+    for ent in per_entity:
+        d = _ceil_pow2(max(len(ent[1]), 1))
+        groups.setdefault(d, []).append(ent)
+
+    buckets = []
+    for d_max, ents in sorted(groups.items()):
+        E = len(ents)
+        entity_ids = np.zeros(E, dtype=np.int32)
+        col_index = np.full((E, d_max), -1, dtype=np.int32)
+        coefficients = np.zeros((E, d_max))
+        variances = None
+        if any(v is not None for *_, v in ents):
+            variances = np.zeros((E, d_max))
+        for i, (e, cols, vals, var) in enumerate(ents):
+            entity_ids[i] = e
+            col_index[i, : len(cols)] = cols
+            coefficients[i, : len(vals)] = vals
+            if var is not None and variances is not None:
+                variances[i, : len(var)] = var
+        buckets.append(
+            BucketCoefficients(
+                entity_ids=entity_ids,
+                col_index=col_index,
+                coefficients=coefficients,
+                variances=variances,
+            )
+        )
+
+    return RandomEffectModel(
+        random_effect_type=re_type,
+        feature_shard=shard,
+        task=task,
+        vocab=vocab,
+        buckets=tuple(buckets),
+        num_features=len(index_map),
+        projection_matrix=projection_matrix,
+    )
+
+
+# ---------------------------------------------------------------------------
+# scoring output (reference ScoreProcessingUtils)
+# ---------------------------------------------------------------------------
+
+
+def save_scoring_results(
+    path: str | os.PathLike,
+    scores: np.ndarray,
+    *,
+    model_id: str = "",
+    labels: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+    uids: Sequence[str | None] | None = None,
+) -> int:
+    """Write ScoringResultAvro records (ScoreProcessingUtils.scala:88)."""
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    n = len(scores)
+
+    def gen():
+        for i in range(n):
+            yield {
+                "uid": None if uids is None else uids[i],
+                "label": None if labels is None else float(labels[i]),
+                "modelId": model_id,
+                "predictionScore": float(scores[i]),
+                "weight": None if weights is None else float(weights[i]),
+                "metadataMap": None,
+            }
+
+    return write_avro_file(path, schemas.SCORING_RESULT_AVRO, gen())
