@@ -1,0 +1,183 @@
+// Memory-mapped immutable feature index store: string key ⇄ int index.
+//
+// TPU-native counterpart of the reference's PalDB-backed off-heap feature
+// index (photon-api index/PalDBIndexMap.scala:43-99): billions of feature
+// names don't fit a Python dict per process, so stores are built offline
+// (FeatureIndexingDriver equivalent), mmap'd read-only, and shared between
+// processes by the page cache. Lookups are O(1): open-addressed hash table
+// (FNV-1a 64, linear probing) over a packed entry blob, plus a reverse
+// offset array for index → name.
+//
+// File layout (little-endian), written by photon_tpu/data/native_index.py:
+//   bytes 0-7    magic "PHIX0001"
+//   u64          n_keys
+//   u64          n_buckets        (power of two, ≥ 2*n_keys)
+//   u64          entry_blob_size
+//   u64[n_buckets]  bucket table: entry offset + 1, 0 = empty
+//   u64[n_keys]     reverse table: local index → entry offset
+//   entry blob:     per entry: u32 key_len, u32 local_index, key bytes
+//
+// C API (ctypes-friendly); thread-safe after open (read-only mapping).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'H', 'I', 'X', '0', '0', '0', '1'};
+constexpr uint64_t kHeaderSize = 8 + 3 * 8;
+
+struct Store {
+  void* base = nullptr;
+  size_t length = 0;
+  uint64_t n_keys = 0;
+  uint64_t n_buckets = 0;
+  const uint64_t* buckets = nullptr;   // [n_buckets]
+  const uint64_t* reverse = nullptr;   // [n_keys]
+  const uint8_t* blob = nullptr;       // entry blob
+  uint64_t blob_size = 0;
+};
+
+inline uint64_t fnv1a64(const uint8_t* data, int64_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= data[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+struct Entry {
+  uint32_t key_len;
+  uint32_t local_index;
+  const uint8_t* key;
+};
+
+inline Entry entry_at(const Store* s, uint64_t off) {
+  Entry e;
+  std::memcpy(&e.key_len, s->blob + off, 4);
+  std::memcpy(&e.local_index, s->blob + off + 4, 4);
+  e.key = s->blob + off + 8;
+  return e;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Opens a store file; returns an opaque handle or nullptr on failure.
+void* fix_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<uint64_t>(st.st_size) < kHeaderSize) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);  // mapping holds its own reference
+  if (base == MAP_FAILED) return nullptr;
+
+  const uint8_t* p = static_cast<const uint8_t*>(base);
+  if (std::memcmp(p, kMagic, 8) != 0) {
+    munmap(base, st.st_size);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->base = base;
+  s->length = st.st_size;
+  std::memcpy(&s->n_keys, p + 8, 8);
+  std::memcpy(&s->n_buckets, p + 16, 8);
+  std::memcpy(&s->blob_size, p + 24, 8);
+  // Overflow-safe sizing: each count must individually fit the file before
+  // the additive check (a huge n_buckets must not wrap `need` past 2^64).
+  uint64_t limit = s->length;
+  bool sane = s->n_buckets <= limit / 8 && s->n_keys <= limit / 8 &&
+              s->blob_size <= limit &&
+              (s->n_buckets == 0 ||
+               (s->n_buckets & (s->n_buckets - 1)) == 0);
+  uint64_t need = sane ? kHeaderSize + 8 * s->n_buckets + 8 * s->n_keys +
+                             s->blob_size
+                       : UINT64_MAX;
+  if (!sane || need > s->length) {
+    munmap(base, st.st_size);
+    delete s;
+    return nullptr;
+  }
+  s->buckets = reinterpret_cast<const uint64_t*>(p + kHeaderSize);
+  s->reverse = s->buckets + s->n_buckets;
+  s->blob = reinterpret_cast<const uint8_t*>(s->reverse + s->n_keys);
+  // Validate every stored entry offset once at open (tables are O(n) and
+  // this is an offline-built store): each entry header + key must lie
+  // inside the blob. Lookups can then dereference without bounds checks.
+  for (uint64_t i = 0; i < s->n_buckets + s->n_keys; ++i) {
+    bool is_bucket = i < s->n_buckets;
+    uint64_t raw = is_bucket ? s->buckets[i] : s->reverse[i - s->n_buckets];
+    if (is_bucket && raw == 0) continue;  // empty bucket
+    uint64_t off = is_bucket ? raw - 1 : raw;
+    if (off + 8 > s->blob_size) {
+      munmap(base, st.st_size);
+      delete s;
+      return nullptr;
+    }
+    uint32_t key_len;
+    std::memcpy(&key_len, s->blob + off, 4);
+    if (off + 8 + key_len > s->blob_size) {
+      munmap(base, st.st_size);
+      delete s;
+      return nullptr;
+    }
+  }
+  return s;
+}
+
+void fix_close(void* handle) {
+  if (!handle) return;
+  Store* s = static_cast<Store*>(handle);
+  munmap(s->base, s->length);
+  delete s;
+}
+
+int64_t fix_size(void* handle) {
+  return handle ? static_cast<int64_t>(static_cast<Store*>(handle)->n_keys)
+                : -1;
+}
+
+// key → local index, or -1 if absent.
+int64_t fix_get_index(void* handle, const char* key, int64_t key_len) {
+  const Store* s = static_cast<const Store*>(handle);
+  if (!s || s->n_buckets == 0) return -1;
+  const uint8_t* k = reinterpret_cast<const uint8_t*>(key);
+  uint64_t mask = s->n_buckets - 1;
+  uint64_t b = fnv1a64(k, key_len) & mask;
+  for (uint64_t probes = 0; probes < s->n_buckets; ++probes) {
+    uint64_t slot = s->buckets[b];
+    if (slot == 0) return -1;  // empty ⇒ not present
+    Entry e = entry_at(s, slot - 1);
+    if (e.key_len == static_cast<uint32_t>(key_len) &&
+        std::memcmp(e.key, k, key_len) == 0) {
+      return static_cast<int64_t>(e.local_index);
+    }
+    b = (b + 1) & mask;
+  }
+  return -1;
+}
+
+// local index → key; writes up to buf_len bytes, returns key length
+// (which may exceed buf_len — caller retries with a larger buffer), or -1.
+int64_t fix_get_name(void* handle, int64_t index, char* buf, int64_t buf_len) {
+  const Store* s = static_cast<const Store*>(handle);
+  if (!s || index < 0 || static_cast<uint64_t>(index) >= s->n_keys) return -1;
+  Entry e = entry_at(s, s->reverse[index]);
+  int64_t n = e.key_len < buf_len ? e.key_len : buf_len;
+  if (n > 0) std::memcpy(buf, e.key, n);
+  return e.key_len;
+}
+
+}  // extern "C"

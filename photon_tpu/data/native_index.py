@@ -1,0 +1,306 @@
+"""Native (C++/mmap) feature index stores: the PalDB equivalent.
+
+Reference parity: photon-api index/PalDBIndexMap.scala:43-99 and
+PalDBIndexMapBuilder — an off-heap, partitioned, memory-mapped feature
+index so >10⁸ feature names never sit in interpreter memory. Stores are
+built offline (see cli/feature_indexing driver), written partition-by-
+partition (partition of a key = crc32(key) % N, global index = local index
++ partition offset — the same layout as PartitionedIndexMap), then opened
+read-only via the C++ library in ``native/feature_index.cpp`` (ctypes).
+A pure-Python mmap reader provides a fallback when no compiler is
+available; both read the same file format.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import mmap
+import os
+import struct
+import subprocess
+from pathlib import Path
+from typing import Iterable, Mapping, Sequence
+
+from photon_tpu.data.index_map import IndexMap, PartitionedIndexMap
+
+MAGIC = b"PHIX0001"
+HEADER = struct.Struct("<8sQQQ")
+METADATA_FILE = "_index_metadata.json"
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libphoton_native.so"
+
+
+# ---------------------------------------------------------------------------
+# store writer (host-side, Python — build is offline and IO-bound)
+# ---------------------------------------------------------------------------
+
+
+def _fnv1a64(data: bytes) -> int:
+    h = 1469598103934665603
+    for b in data:
+        h ^= b
+        h = (h * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def write_store(path: str | os.PathLike, keys: Sequence[str]) -> None:
+    """Write one partition store: keys get local indices 0..n-1 in order."""
+    n = len(keys)
+    n_buckets = 1
+    while n_buckets < max(2 * n, 1):
+        n_buckets *= 2
+
+    encoded = [key.encode("utf-8") for key in keys]
+    blob = bytearray()
+    offsets = []
+    for i, kb in enumerate(encoded):
+        offsets.append(len(blob))
+        blob += struct.pack("<II", len(kb), i)
+        blob += kb
+
+    buckets = [0] * n_buckets
+    mask = n_buckets - 1
+    for i, kb in enumerate(encoded):
+        b = _fnv1a64(kb) & mask
+        while buckets[b] != 0:
+            b = (b + 1) & mask
+        buckets[b] = offsets[i] + 1
+
+    with open(path, "wb") as f:
+        f.write(HEADER.pack(MAGIC, n, n_buckets, len(blob)))
+        f.write(struct.pack(f"<{n_buckets}Q", *buckets))
+        if n:
+            f.write(struct.pack(f"<{n}Q", *offsets))
+        f.write(bytes(blob))
+
+
+# ---------------------------------------------------------------------------
+# native library loading
+# ---------------------------------------------------------------------------
+
+_lib = None
+_lib_unavailable = False
+
+
+def _load_native_lib():
+    """Load (building if necessary) the C++ store reader; None if impossible."""
+    global _lib, _lib_unavailable
+    if _lib is not None or _lib_unavailable:
+        return _lib
+    try:
+        if not _LIB_PATH.exists():
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.fix_open.restype = ctypes.c_void_p
+        lib.fix_open.argtypes = [ctypes.c_char_p]
+        lib.fix_close.argtypes = [ctypes.c_void_p]
+        lib.fix_size.restype = ctypes.c_int64
+        lib.fix_size.argtypes = [ctypes.c_void_p]
+        lib.fix_get_index.restype = ctypes.c_int64
+        lib.fix_get_index.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        lib.fix_get_name.restype = ctypes.c_int64
+        lib.fix_get_name.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        _lib = lib
+    except (OSError, subprocess.CalledProcessError):
+        _lib_unavailable = True
+    return _lib
+
+
+# ---------------------------------------------------------------------------
+# store readers
+# ---------------------------------------------------------------------------
+
+
+class NativeStore(IndexMap):
+    """One partition, read through the C++ mmap library."""
+
+    def __init__(self, path: str | os.PathLike):
+        lib = _load_native_lib()
+        if lib is None:
+            raise OSError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.fix_open(str(path).encode())
+        if not self._handle:
+            raise OSError(f"cannot open index store {path}")
+        self._size = int(lib.fix_size(self._handle))
+
+    def get_index(self, key: str) -> int:
+        kb = key.encode("utf-8")
+        return int(self._lib.fix_get_index(self._handle, kb, len(kb)))
+
+    def get_feature_name(self, idx: int) -> str | None:
+        # Per-call buffer: the store itself is thread-safe, so the wrapper
+        # must not share mutable state between concurrent lookups.
+        buf = ctypes.create_string_buffer(256)
+        n = int(self._lib.fix_get_name(self._handle, idx, buf, len(buf)))
+        if n < 0:
+            return None
+        if n > len(buf):
+            buf = ctypes.create_string_buffer(n)
+            self._lib.fix_get_name(self._handle, idx, buf, n)
+        return buf.raw[:n].decode("utf-8")
+
+    def __len__(self) -> int:
+        return self._size
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.fix_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # release the mapping
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PyMmapStore(IndexMap):
+    """Pure-Python mmap reader of the same format (compiler-free fallback)."""
+
+    def __init__(self, path: str | os.PathLike):
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        if len(self._mm) < HEADER.size:
+            raise OSError(f"{path}: truncated index store")
+        magic, n, n_buckets, blob_size = HEADER.unpack_from(self._mm, 0)
+        if magic != MAGIC:
+            raise OSError(f"{path}: bad index store magic")
+        if (
+            HEADER.size + 8 * (n_buckets + n) + blob_size > len(self._mm)
+            or (n_buckets and n_buckets & (n_buckets - 1))
+        ):
+            raise OSError(f"{path}: corrupt index store header")
+        self._n = n
+        self._n_buckets = n_buckets
+        self._buckets_off = HEADER.size
+        self._reverse_off = self._buckets_off + 8 * n_buckets
+        self._blob_off = self._reverse_off + 8 * n
+        self._blob_size = blob_size
+        # Validate stored offsets once at open (mirrors the C++ reader).
+        for i in range(n_buckets + n):
+            if i < n_buckets:
+                (raw,) = struct.unpack_from(
+                    "<Q", self._mm, self._buckets_off + 8 * i
+                )
+                if raw == 0:
+                    continue
+                off = raw - 1
+            else:
+                (off,) = struct.unpack_from(
+                    "<Q", self._mm, self._reverse_off + 8 * (i - n_buckets)
+                )
+            if off + 8 > blob_size:
+                raise OSError(f"{path}: corrupt entry offset")
+            (klen,) = struct.unpack_from("<I", self._mm, self._blob_off + off)
+            if off + 8 + klen > blob_size:
+                raise OSError(f"{path}: corrupt entry length")
+
+    def _entry(self, off: int) -> tuple[bytes, int]:
+        base = self._blob_off + off
+        klen, idx = struct.unpack_from("<II", self._mm, base)
+        key = self._mm[base + 8 : base + 8 + klen]
+        return key, idx
+
+    def get_index(self, key: str) -> int:
+        kb = key.encode("utf-8")
+        mask = self._n_buckets - 1
+        b = _fnv1a64(kb) & mask
+        for _ in range(self._n_buckets):
+            (slot,) = struct.unpack_from(
+                "<Q", self._mm, self._buckets_off + 8 * b
+            )
+            if slot == 0:
+                return -1
+            ek, idx = self._entry(slot - 1)
+            if ek == kb:
+                return idx
+            b = (b + 1) & mask
+        return -1
+
+    def get_feature_name(self, idx: int) -> str | None:
+        if not 0 <= idx < self._n:
+            return None
+        (off,) = struct.unpack_from(
+            "<Q", self._mm, self._reverse_off + 8 * idx
+        )
+        key, _ = self._entry(off)
+        return key.decode("utf-8")
+
+    def __len__(self) -> int:
+        return self._n
+
+    def close(self) -> None:
+        if getattr(self, "_mm", None) is not None:
+            self._mm.close()
+            self._f.close()
+            self._mm = None
+
+
+def open_store(path: str | os.PathLike, prefer_native: bool = True) -> IndexMap:
+    if prefer_native and _load_native_lib() is not None:
+        return NativeStore(path)
+    return PyMmapStore(path)
+
+
+# ---------------------------------------------------------------------------
+# partitioned store dir (the PalDB N-store layout)
+# ---------------------------------------------------------------------------
+
+
+def build_partitioned_store(
+    out_dir: str | os.PathLike,
+    shard_keys: Mapping[str, Iterable[str]],
+    num_partitions: int = 1,
+) -> None:
+    """Write per-shard partitioned stores (reference FeatureIndexingDriver:
+    partitionBy then one PalDB store per partition)."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    meta = {"numPartitions": num_partitions, "shards": {}}
+    for shard, keys in shard_keys.items():
+        parts: list[list[str]] = [[] for _ in range(num_partitions)]
+        for k in keys:
+            # Same routing as the reader — must stay byte-identical.
+            parts[PartitionedIndexMap._partition_of(k, num_partitions)].append(k)
+        sizes = []
+        for p, part_keys in enumerate(parts):
+            part_keys.sort()
+            write_store(out / f"{shard}-{p}.phix", part_keys)
+            sizes.append(len(part_keys))
+        meta["shards"][shard] = sizes
+    (out / METADATA_FILE).write_text(json.dumps(meta, indent=2))
+
+
+def load_partitioned_store(
+    store_dir: str | os.PathLike,
+    shard: str,
+    prefer_native: bool = True,
+) -> PartitionedIndexMap:
+    """Open one shard's partition stores as a global IndexMap
+    (global idx = local idx + partition offset, PalDBIndexMap.scala:69-99)."""
+    d = Path(store_dir)
+    meta = json.loads((d / METADATA_FILE).read_text())
+    if shard not in meta["shards"]:
+        raise KeyError(f"shard {shard!r} not in index store {store_dir}")
+    n = meta["numPartitions"]
+    partitions = [
+        open_store(d / f"{shard}-{p}.phix", prefer_native=prefer_native)
+        for p in range(n)
+    ]
+    return PartitionedIndexMap(partitions)

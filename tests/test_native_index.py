@@ -1,0 +1,170 @@
+"""Native feature index store tests (format, C++ reader, fallback, parity).
+
+Mirrors the reference PalDBIndexMapTest tier: build partitioned stores,
+reload, and assert name⇄index round-trips and global-offset layout.
+"""
+import numpy as np
+import pytest
+
+from photon_tpu.data.index_map import feature_key
+from photon_tpu.data.native_index import (
+    NativeStore,
+    PyMmapStore,
+    _load_native_lib,
+    build_partitioned_store,
+    load_partitioned_store,
+    open_store,
+    write_store,
+)
+
+KEYS = [feature_key(f"f{i}", "t") for i in range(100)] + [
+    feature_key("unicode", "hélloweird"),
+    "",
+]
+
+
+@pytest.fixture
+def store_path(tmp_path):
+    p = tmp_path / "part.phix"
+    write_store(p, KEYS)
+    return p
+
+
+def _check_roundtrip(store):
+    assert len(store) == len(KEYS)
+    for i, k in enumerate(KEYS):
+        assert store.get_index(k) == i, k
+        assert store.get_feature_name(i) == k
+    assert store.get_index("missing-key") == -1
+    assert store.get_feature_name(len(KEYS)) is None
+    assert store.get_feature_name(-1) is None
+
+
+def test_python_mmap_reader(store_path):
+    store = PyMmapStore(store_path)
+    _check_roundtrip(store)
+    store.close()
+
+
+def test_native_reader(store_path):
+    if _load_native_lib() is None:
+        pytest.skip("no C++ toolchain available")
+    store = NativeStore(store_path)
+    _check_roundtrip(store)
+    store.close()
+
+
+def test_native_and_python_agree(store_path):
+    if _load_native_lib() is None:
+        pytest.skip("no C++ toolchain available")
+    native = NativeStore(store_path)
+    py = PyMmapStore(store_path)
+    rng = np.random.default_rng(0)
+    probes = [KEYS[i] for i in rng.integers(0, len(KEYS), 30)] + [
+        "nope", "f1", feature_key("f1", "x")
+    ]
+    for k in probes:
+        assert native.get_index(k) == py.get_index(k), k
+    native.close()
+    py.close()
+
+
+def test_empty_store(tmp_path):
+    p = tmp_path / "empty.phix"
+    write_store(p, [])
+    store = open_store(p)
+    assert len(store) == 0
+    assert store.get_index("anything") == -1
+
+
+def test_long_key_exceeding_name_buffer(tmp_path):
+    long_key = "k" * 1000
+    p = tmp_path / "long.phix"
+    write_store(p, [long_key])
+    store = open_store(p)
+    assert store.get_feature_name(0) == long_key
+    assert store.get_index(long_key) == 0
+
+
+def test_partitioned_store_roundtrip(tmp_path):
+    shard_keys = {
+        "global": [feature_key(f"g{i}") for i in range(57)],
+        "per_user": [feature_key(f"u{i}") for i in range(13)],
+    }
+    build_partitioned_store(tmp_path / "store", shard_keys, num_partitions=4)
+    imap = load_partitioned_store(tmp_path / "store", "global")
+    assert len(imap) == 57
+    seen = set()
+    for k in shard_keys["global"]:
+        idx = imap.get_index(k)
+        assert 0 <= idx < 57
+        assert imap.get_feature_name(idx) == k
+        seen.add(idx)
+    assert len(seen) == 57  # globally unique via partition offsets
+    assert imap.get_index(feature_key("u1")) == -1
+
+    imap2 = load_partitioned_store(tmp_path / "store", "per_user")
+    assert len(imap2) == 13
+    with pytest.raises(KeyError):
+        load_partitioned_store(tmp_path / "store", "absent")
+
+
+def test_corrupt_store_rejected(tmp_path):
+    p = tmp_path / "bad.phix"
+    p.write_bytes(b"JUNKJUNK" + b"\x00" * 100)
+    with pytest.raises(OSError):
+        PyMmapStore(p)
+    if _load_native_lib() is not None:
+        with pytest.raises(OSError):
+            NativeStore(p)
+
+
+def test_scale_100k_keys(tmp_path):
+    keys = [feature_key(f"name{i}", f"term{i % 7}") for i in range(100_000)]
+    p = tmp_path / "big.phix"
+    write_store(p, keys)
+    store = open_store(p)
+    rng = np.random.default_rng(1)
+    for i in rng.integers(0, len(keys), 200):
+        assert store.get_index(keys[i]) == i
+        assert store.get_feature_name(int(i)) == keys[i]
+
+
+def test_overflowing_header_rejected(tmp_path):
+    """A header with a huge power-of-two bucket count must not wrap the
+    size check and be accepted (it would SIGSEGV on first lookup)."""
+    import struct as _struct
+
+    p = tmp_path / "overflow.phix"
+    # n_keys=1, n_buckets=2^61 (power of two), blob_size=0
+    p.write_bytes(
+        b"PHIX0001"
+        + _struct.pack("<QQQ", 1, 1 << 61, 0)
+        + b"\x00" * 64
+    )
+    with pytest.raises(OSError):
+        PyMmapStore(p)  # python reader hits short unpack → OSError? ensure below
+    if _load_native_lib() is not None:
+        with pytest.raises(OSError):
+            NativeStore(p)
+
+
+def test_out_of_range_entry_offset_rejected(tmp_path):
+    """A bucket pointing past the blob must be rejected at open (native)."""
+    import struct as _struct
+
+    if _load_native_lib() is None:
+        pytest.skip("no C++ toolchain available")
+    p = tmp_path / "badoff.phix"
+    # n_keys=1, n_buckets=2, blob_size=16; bucket offset points past blob
+    blob = _struct.pack("<II", 4, 0) + b"abcd" + b"\x00" * 4
+    data = (
+        b"PHIX0001"
+        + _struct.pack("<QQQ", 1, 2, len(blob))
+        + _struct.pack("<QQ", 1000 + 1, 0)  # bucket: bogus offset
+        + _struct.pack("<Q", 0)  # reverse
+        + blob
+    )
+    p.write_bytes(data)
+    with pytest.raises(OSError):
+        NativeStore(p)
