@@ -121,14 +121,16 @@ void* fix_open(const char* path) {
     uint64_t raw = is_bucket ? s->buckets[i] : s->reverse[i - s->n_buckets];
     if (is_bucket && raw == 0) continue;  // empty bucket
     uint64_t off = is_bucket ? raw - 1 : raw;
-    if (off + 8 > s->blob_size) {
+    // Overflow-safe: `off + 8` could wrap for a hostile stored offset, so
+    // compare against the remaining space instead.
+    if (off > s->blob_size || s->blob_size - off < 8) {
       munmap(base, st.st_size);
       delete s;
       return nullptr;
     }
     uint32_t key_len;
     std::memcpy(&key_len, s->blob + off, 4);
-    if (off + 8 + key_len > s->blob_size) {
+    if (key_len > s->blob_size - off - 8) {
       munmap(base, st.st_size);
       delete s;
       return nullptr;

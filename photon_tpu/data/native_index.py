@@ -18,6 +18,8 @@ import mmap
 import os
 import struct
 import subprocess
+
+import numpy as np
 from pathlib import Path
 from typing import Iterable, Mapping, Sequence
 
@@ -48,6 +50,10 @@ def _fnv1a64(data: bytes) -> int:
 def write_store(path: str | os.PathLike, keys: Sequence[str]) -> None:
     """Write one partition store: keys get local indices 0..n-1 in order."""
     n = len(keys)
+    if len(set(keys)) != n:
+        # A duplicate would leave unreachable indices and an inconsistent
+        # reverse table; fail at build time, not as wrong lookups later.
+        raise ValueError("duplicate keys in index store partition")
     n_buckets = 1
     while n_buckets < max(2 * n, 1):
         n_buckets *= 2
@@ -90,12 +96,15 @@ def _load_native_lib():
     if _lib is not None or _lib_unavailable:
         return _lib
     try:
-        if not _LIB_PATH.exists():
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR)],
-                check=True,
-                capture_output=True,
-            )
+        # Always invoke make: it is a no-op when the .so is current, and it
+        # rebuilds after feature_index.cpp changes instead of silently using
+        # a stale library. The Makefile links to a temp file and atomically
+        # renames, so concurrent first-use builds can't load a torn .so.
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+        )
         lib = ctypes.CDLL(str(_LIB_PATH))
         lib.fix_open.restype = ctypes.c_void_p
         lib.fix_open.argtypes = [ctypes.c_char_p]
@@ -183,7 +192,8 @@ class PyMmapStore(IndexMap):
             raise OSError(f"{path}: bad index store magic")
         if (
             HEADER.size + 8 * (n_buckets + n) + blob_size > len(self._mm)
-            or (n_buckets and n_buckets & (n_buckets - 1))
+            or n_buckets < 1  # the writer always emits >= 1 bucket
+            or n_buckets & (n_buckets - 1)
         ):
             raise OSError(f"{path}: corrupt index store header")
         self._n = n
@@ -192,23 +202,29 @@ class PyMmapStore(IndexMap):
         self._reverse_off = self._buckets_off + 8 * n_buckets
         self._blob_off = self._reverse_off + 8 * n
         self._blob_size = blob_size
-        # Validate stored offsets once at open (mirrors the C++ reader).
-        for i in range(n_buckets + n):
-            if i < n_buckets:
-                (raw,) = struct.unpack_from(
-                    "<Q", self._mm, self._buckets_off + 8 * i
-                )
-                if raw == 0:
-                    continue
-                off = raw - 1
-            else:
-                (off,) = struct.unpack_from(
-                    "<Q", self._mm, self._reverse_off + 8 * (i - n_buckets)
-                )
-            if off + 8 > blob_size:
+        # Validate stored offsets once at open (mirrors the C++ reader) —
+        # vectorized: this fallback must still open >10⁸-key stores quickly.
+        raw = np.frombuffer(
+            self._mm, dtype="<u8", count=n_buckets, offset=self._buckets_off
+        )
+        occupied = raw[raw != 0] - 1
+        rev = np.frombuffer(
+            self._mm, dtype="<u8", count=n, offset=self._reverse_off
+        )
+        offs = np.concatenate([occupied, rev])
+        if offs.size:
+            if (offs > blob_size - 8).any():  # blob_size >= 8 iff any entry
                 raise OSError(f"{path}: corrupt entry offset")
-            (klen,) = struct.unpack_from("<I", self._mm, self._blob_off + off)
-            if off + 8 + klen > blob_size:
+            blob = np.frombuffer(
+                self._mm, dtype=np.uint8, count=blob_size, offset=self._blob_off
+            )
+            klens = (
+                blob[offs.astype(np.int64)].astype(np.uint64)
+                | (blob[offs.astype(np.int64) + 1].astype(np.uint64) << 8)
+                | (blob[offs.astype(np.int64) + 2].astype(np.uint64) << 16)
+                | (blob[offs.astype(np.int64) + 3].astype(np.uint64) << 24)
+            )
+            if (klens > blob_size - 8 - offs).any():
                 raise OSError(f"{path}: corrupt entry length")
 
     def _entry(self, off: int) -> tuple[bytes, int]:

@@ -1,0 +1,22 @@
+"""Device-side tracing: wrap pipeline phases in ``jax.profiler`` annotations
+(the TPU-native counterpart of the reference's Timed + per-phase logging —
+SURVEY.md §5.1). Annotations show up in a captured profiler trace; when no
+trace is being captured they are free."""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def trace_phase(name: str) -> Iterator[None]:
+    """``with trace_phase("fixed-effect solve"): ...`` — emits a named
+    TraceAnnotation visible in TensorBoard/perfetto profiles."""
+    try:
+        import jax.profiler
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler unavailable
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
