@@ -1,0 +1,14 @@
+"""Drivers / CLI layer (reference photon-client, L9).
+
+Five entry points, mirroring the reference's ``main()`` classes:
+
+- ``photon_tpu.cli.game_training``   GAME training (GameTrainingDriver.scala:822)
+- ``photon_tpu.cli.game_scoring``    GAME scoring  (GameScoringDriver.scala:260)
+- ``photon_tpu.cli.legacy_driver``   single-GLM staged pipeline (Driver.scala:685)
+- ``photon_tpu.cli.feature_indexing`` native index-store builder
+  (FeatureIndexingDriver.scala:307)
+- ``photon_tpu.cli.name_term_bags``  feature-bag extraction
+  (NameAndTermFeatureBagsDriver.scala:206)
+
+Run as ``python -m photon_tpu.cli.game_training --help`` etc.
+"""

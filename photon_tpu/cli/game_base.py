@@ -1,0 +1,124 @@
+"""Shared GAME driver plumbing (reference cli/game/GameDriver.scala):
+common CLI parameters, feature-map preparation (off-heap store vs generated),
+and date-ranged input resolution."""
+from __future__ import annotations
+
+import argparse
+import os
+
+from photon_tpu.cli.parsing import (
+    parse_evaluators,
+    parse_feature_shard_config,
+)
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.data.native_index import load_partitioned_store
+from photon_tpu.game.data import GameData
+from photon_tpu.io.data_reader import AvroDataReader, FeatureShardConfig
+from photon_tpu.util import DateRange, DaysRange, resolve_date_range_paths
+
+
+def add_common_arguments(p: argparse.ArgumentParser) -> None:
+    """Arguments shared by the training and scoring drivers
+    (reference GameDriver.scala:56-130)."""
+    p.add_argument(
+        "--input-data-directories",
+        required=True,
+        help="comma-separated input dirs of Avro part files",
+    )
+    p.add_argument(
+        "--input-data-date-range",
+        default=None,
+        help="yyyyMMdd-yyyyMMdd window of daily partitions under each input dir",
+    )
+    p.add_argument(
+        "--input-data-days-range",
+        default=None,
+        help="start-end in days ago, resolved against today",
+    )
+    p.add_argument(
+        "--feature-shard-configurations",
+        action="append",
+        required=True,
+        metavar="name=<shard>,feature.bags=<bag1|bag2>[,intercept=<bool>]",
+        help="repeatable; one feature shard definition per instance",
+    )
+    p.add_argument(
+        "--off-heap-index-map-dir",
+        default=None,
+        help="directory of native index stores built by feature_indexing",
+    )
+    p.add_argument("--evaluators", default=None, help="comma-separated evaluator types")
+    p.add_argument(
+        "--root-output-directory", required=True, help="driver output root"
+    )
+    p.add_argument(
+        "--override-output-directory",
+        action="store_true",
+        help="replace an existing output directory",
+    )
+    p.add_argument("--log-level", default="info")
+    p.add_argument("--application-name", default="photon-tpu")
+
+
+def parse_shard_configs(args) -> dict[str, FeatureShardConfig]:
+    configs = {}
+    for s in args.feature_shard_configurations:
+        name, cfg = parse_feature_shard_config(s)
+        if name in configs:
+            raise ValueError(f"duplicate feature shard {name!r}")
+        configs[name] = cfg
+    return configs
+
+
+def resolve_input_paths(args) -> list[str]:
+    """Input dirs, optionally expanded to daily partitions in a date range."""
+    roots = [p.strip() for p in args.input_data_directories.split(",") if p.strip()]
+    date_range = None
+    if args.input_data_date_range:
+        date_range = DateRange.parse(args.input_data_date_range)
+    elif args.input_data_days_range:
+        date_range = DaysRange.parse(args.input_data_days_range).to_date_range()
+    if date_range is None:
+        return roots
+    paths: list[str] = []
+    for root in roots:
+        paths.extend(resolve_date_range_paths(root, date_range))
+    return paths
+
+
+def prepare_feature_maps(
+    args, shard_configs: dict[str, FeatureShardConfig]
+) -> dict[str, IndexMap] | None:
+    """Off-heap native stores when configured, else None (the reader
+    generates in-memory maps from the data — reference prepareFeatureMaps'
+    PalDB vs DefaultIndexMap split)."""
+    if not args.off_heap_index_map_dir:
+        return None
+    return {
+        shard: load_partitioned_store(args.off_heap_index_map_dir, shard)
+        for shard in shard_configs
+    }
+
+
+def read_game_data(
+    paths,
+    shard_configs: dict[str, FeatureShardConfig],
+    index_maps: dict[str, IndexMap] | None,
+    id_tags=(),
+) -> tuple[GameData, dict[str, IndexMap]]:
+    reader = AvroDataReader(index_maps=index_maps)
+    data = reader.read(paths, shard_configs, id_tags=tuple(id_tags))
+    return data, reader.index_maps
+
+
+def evaluators_from_args(args):
+    return parse_evaluators(args.evaluators) if args.evaluators else []
+
+
+def ensure_single_process_jax() -> None:
+    """Pin the platform before the first JAX import side effects when the
+    caller asked for CPU (tests / airgapped runs)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,124 @@
+"""GAME scoring driver (reference cli/game/scoring/GameScoringDriver.scala:
+load a saved GAME model, score a dataset, optionally evaluate, write
+ScoringResultAvro part files)."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+from photon_tpu.cli import game_base
+from photon_tpu.game.transformer import GameTransformer
+from photon_tpu.io.model_io import load_game_model, save_scoring_results
+from photon_tpu.util import EventEmitter, PhotonLogger, Timed, prepare_output_dir
+
+SCORES_DIR = "scores"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="game-scoring", description=__doc__)
+    game_base.add_common_arguments(p)
+    p.add_argument(
+        "--model-input-directory",
+        required=True,
+        help="directory written by the training driver (best/ or models/<i>/)",
+    )
+    p.add_argument("--model-id", default="", help="tag written to every record")
+    p.add_argument(
+        "--log-data-and-model-stats",
+        action="store_true",
+        help="log per-coordinate model summaries before scoring",
+    )
+    return p
+
+
+def run(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    game_base.ensure_single_process_jax()
+
+    shard_configs = game_base.parse_shard_configs(args)
+    out_root = prepare_output_dir(
+        args.root_output_directory, override=args.override_output_directory
+    )
+    emitter = EventEmitter()
+    with PhotonLogger(
+        os.path.join(out_root, "driver.log"), level=args.log_level
+    ) as log:
+        emitter.emit("setup", application=args.application_name)
+
+        # Feature maps must come from the stores / the model's own vocabulary,
+        # not the scoring data — otherwise indices won't line up.
+        index_maps = game_base.prepare_feature_maps(args, shard_configs)
+        with Timed("load model"):
+            if index_maps is None:
+                from photon_tpu.io.model_io import read_model_feature_keys
+                index_maps = read_model_feature_keys(
+                    args.model_input_directory, shard_configs
+                )
+            model = load_game_model(args.model_input_directory, index_maps)
+        if args.log_data_and_model_stats:
+            for cid, cm in model.coordinates.items():
+                log.info("coordinate %s: %s", cid, type(cm).__name__)
+
+        id_tags = sorted(
+            {
+                cm.random_effect_type
+                for cm in model.coordinates.values()
+                if hasattr(cm, "random_effect_type")
+            }
+        )
+        with Timed("read scoring data"):
+            paths = game_base.resolve_input_paths(args)
+            data, _ = game_base.read_game_data(
+                paths, shard_configs, index_maps, id_tags
+            )
+        log.info("scoring %d samples", data.num_samples)
+
+        transformer = GameTransformer(model=model, task=model.task)
+        with Timed("score"):
+            scores = np.asarray(transformer.score(data))
+
+        evaluations = {}
+        requested = game_base.evaluators_from_args(args)
+        has_labels = bool(np.all(np.isfinite(data.labels)))
+        if requested and not has_labels:
+            log.warning("scoring data has missing labels; skipping evaluators")
+        elif requested:
+            import jax.numpy as jnp
+
+            from photon_tpu.evaluation.evaluators import evaluate
+
+            s = jnp.asarray(scores)
+            lab = jnp.asarray(data.labels)
+            w = jnp.asarray(data.weights)
+            for ev in requested:
+                evaluations[ev.name] = float(evaluate(ev, s, lab, w))
+                log.info("%s = %.6f", ev.name, evaluations[ev.name])
+
+        with Timed("save scores"):
+            n = save_scoring_results(
+                os.path.join(out_root, SCORES_DIR, "part-00000.avro"),
+                scores,
+                model_id=args.model_id,
+                labels=data.labels,
+                weights=data.weights,
+                uids=data.uids,
+            )
+        with open(os.path.join(out_root, "scoring-summary.json"), "w") as f:
+            json.dump(
+                {"numScored": n, "evaluations": evaluations}, f, indent=2
+            )
+        emitter.emit("scoring_finish", num_scored=n)
+    emitter.close()
+    return {"scores": scores, "evaluations": evaluations, "output": out_root}
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

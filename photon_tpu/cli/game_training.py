@@ -1,0 +1,380 @@
+"""GAME training driver (reference cli/game/training/GameTrainingDriver.scala).
+
+Pipeline (reference ``run`` :335-474): read Avro → feature maps → data
+validation → per-shard stats + normalization contexts → GameEstimator.fit
+over the λ grid (warm-started) → optional hyperparameter tuning → model
+selection → save model(s).
+
+Usage:
+    python -m photon_tpu.cli.game_training \
+      --input-data-directories /data/train \
+      --root-output-directory /out \
+      --training-task LOGISTIC_REGRESSION \
+      --feature-shard-configurations name=global,feature.bags=features \
+      --coordinate-configurations name=global,feature.shard=global,optimizer=LBFGS,regularization=L2,reg.weights=1|10 \
+      --coordinate-update-sequence global \
+      --coordinate-descent-iterations 1
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import json
+import os
+import sys
+
+import numpy as np
+
+from photon_tpu.cli import game_base
+from photon_tpu.cli.parsing import parse_coordinate_config
+from photon_tpu.data.stats import BasicStatisticalSummary
+from photon_tpu.data.validators import DataValidationType, validate_game_data
+from photon_tpu.evaluation.evaluators import EvaluatorType
+from photon_tpu.game.estimator import GameEstimator, GameTrainingResult
+from photon_tpu.game.tuning import run_hyperparameter_tuning
+from photon_tpu.io.model_io import save_game_model
+from photon_tpu.ops.normalization import NormalizationContext
+from photon_tpu.types import NormalizationType, TaskType
+from photon_tpu.util import EventEmitter, PhotonLogger, Timed, prepare_output_dir
+
+MODELS_DIR = "models"
+BEST_MODEL_DIR = "best"
+SUMMARY_FILE = "training-summary.json"
+
+
+class ModelOutputMode(enum.Enum):
+    """Which trained models to persist (reference ModelOutputMode.scala)."""
+
+    NONE = "NONE"
+    BEST = "BEST"
+    ALL = "ALL"
+
+
+class HyperparameterTuningMode(enum.Enum):
+    NONE = "NONE"
+    RANDOM = "RANDOM"
+    BAYESIAN = "BAYESIAN"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="game-training",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    game_base.add_common_arguments(p)
+    p.add_argument(
+        "--training-task",
+        required=True,
+        choices=[t.name for t in TaskType],
+    )
+    p.add_argument("--validation-data-directories", default=None)
+    p.add_argument("--validation-data-date-range", default=None)
+    p.add_argument(
+        "--coordinate-configurations",
+        action="append",
+        required=True,
+        metavar="name=<id>,feature.shard=<shard>,...",
+        help="repeatable; one coordinate per instance (see cli/parsing.py)",
+    )
+    p.add_argument(
+        "--coordinate-update-sequence",
+        required=True,
+        help="comma-separated coordinate ids, trained in order",
+    )
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument(
+        "--normalization",
+        default="NONE",
+        choices=[t.name for t in NormalizationType],
+    )
+    p.add_argument("--data-summary-directory", default=None)
+    p.add_argument(
+        "--partial-retrain-locked-coordinates",
+        default=None,
+        help="comma-separated coordinate ids to keep fixed (requires --model-input-directory)",
+    )
+    p.add_argument("--model-input-directory", default=None)
+    p.add_argument(
+        "--output-mode",
+        default="BEST",
+        choices=[m.name for m in ModelOutputMode],
+    )
+    p.add_argument(
+        "--hyper-parameter-tuning",
+        default="NONE",
+        choices=[m.name for m in HyperparameterTuningMode],
+    )
+    p.add_argument("--hyper-parameter-tuning-iter", type=int, default=10)
+    p.add_argument("--compute-variance", action="store_true")
+    p.add_argument("--model-sparsity-threshold", type=float, default=1e-4)
+    p.add_argument(
+        "--data-validation",
+        default="VALIDATE_FULL",
+        choices=[t.name for t in DataValidationType],
+    )
+    return p
+
+
+def _normalization_contexts(
+    norm_type: NormalizationType, data, shard_configs, index_maps
+) -> tuple[dict[str, NormalizationContext], dict[str, BasicStatisticalSummary]]:
+    """Per-shard stats + normalization contexts (reference
+    prepareNormalizationContextWrappers, GameEstimator.scala:698)."""
+    from photon_tpu.data.index_map import INTERCEPT_KEY
+
+    contexts: dict[str, NormalizationContext] = {}
+    summaries: dict[str, BasicStatisticalSummary] = {}
+    for shard in shard_configs:
+        summary = BasicStatisticalSummary.of(data.shard_dataset(shard))
+        summaries[shard] = summary
+        icpt = index_maps[shard].get_index(INTERCEPT_KEY)
+        contexts[shard] = NormalizationContext.build(
+            norm_type,
+            mean=summary.mean,
+            variance=summary.variance,
+            max_magnitude=np.maximum(np.abs(summary.max), np.abs(summary.min)),
+            intercept_index=None if icpt < 0 else icpt,
+        )
+    return contexts, summaries
+
+
+def _save_summary_stats(path, summaries, index_maps) -> None:
+    """Feature stats output (reference calculateAndSaveFeatureShardStats;
+    FeatureSummarizationResultAvro is JSON-mirrored here)."""
+    os.makedirs(path, exist_ok=True)
+    for shard, s in summaries.items():
+        rows = []
+        imap = index_maps[shard]
+        for j in range(len(imap)):
+            rows.append(
+                {
+                    "featureKey": imap.get_feature_name(j),
+                    "mean": float(s.mean[j]),
+                    "variance": float(s.variance[j]),
+                    "numNonzeros": int(s.num_nonzeros[j]),
+                    "max": float(s.max[j]),
+                    "min": float(s.min[j]),
+                    "normL1": float(s.norm_l1[j]),
+                    "normL2": float(s.norm_l2[j]),
+                    "meanAbs": float(s.mean_abs[j]),
+                }
+            )
+        with open(os.path.join(path, f"{shard}.json"), "w") as f:
+            json.dump({"count": s.count, "features": rows}, f, indent=2)
+
+
+def _select_best(
+    results: list[GameTrainingResult], evaluator: EvaluatorType | None
+) -> int:
+    """Index of the best model (reference selectBestModel :677-720): by
+    validation metric when present, else the most-regularized (first)."""
+    if evaluator is None or all(r.evaluation is None for r in results):
+        return 0
+    vals = [
+        (r.evaluation if r.evaluation is not None else -np.inf)
+        if evaluator.larger_is_better
+        else (r.evaluation if r.evaluation is not None else np.inf)
+        for r in results
+    ]
+    return int(np.argmax(vals) if evaluator.larger_is_better else np.argmin(vals))
+
+
+def run(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    game_base.ensure_single_process_jax()
+
+    task = TaskType[args.training_task]
+    shard_configs = game_base.parse_shard_configs(args)
+    coordinate_configs = {}
+    for s in args.coordinate_configurations:
+        name, cfg = parse_coordinate_config(s, task)
+        if name in coordinate_configs:
+            raise ValueError(f"duplicate coordinate {name!r}")
+        if args.compute_variance:
+            from photon_tpu.optimize.problem import VarianceComputationType
+
+            cfg = dataclasses.replace(
+                cfg,
+                optimization=dataclasses.replace(
+                    cfg.optimization,
+                    variance_computation=VarianceComputationType.FULL,
+                ),
+            )
+        coordinate_configs[name] = cfg
+    update_sequence = [
+        c.strip() for c in args.coordinate_update_sequence.split(",") if c.strip()
+    ]
+    missing_shards = {
+        c.feature_shard for c in coordinate_configs.values()
+    } - set(shard_configs)
+    if missing_shards:
+        raise ValueError(f"coordinates reference unknown shards {missing_shards}")
+    locked = frozenset(
+        c.strip()
+        for c in (args.partial_retrain_locked_coordinates or "").split(",")
+        if c.strip()
+    )
+    if locked and not args.model_input_directory:
+        raise ValueError(
+            "--partial-retrain-locked-coordinates requires --model-input-directory"
+        )
+    id_tags = sorted(
+        {
+            c.random_effect_type
+            for c in coordinate_configs.values()
+            if c.is_random_effect
+        }
+    )
+    evaluators = game_base.evaluators_from_args(args)
+    validation_evaluator = evaluators[0] if evaluators else None
+
+    out_root = prepare_output_dir(
+        args.root_output_directory, override=args.override_output_directory
+    )
+    emitter = EventEmitter()
+    with PhotonLogger(
+        os.path.join(out_root, "driver.log"), level=args.log_level
+    ) as log:
+        emitter.emit("setup", application=args.application_name)
+
+        with Timed("read training data"):
+            paths = game_base.resolve_input_paths(args)
+            index_maps = game_base.prepare_feature_maps(args, shard_configs)
+            data, index_maps = game_base.read_game_data(
+                paths, shard_configs, index_maps, id_tags
+            )
+        log.info(
+            "read %d samples, shards %s",
+            data.num_samples,
+            {s: m.num_cols for s, m in data.feature_shards.items()},
+        )
+
+        validation_data = None
+        if args.validation_data_directories:
+            with Timed("read validation data"):
+                v_args = argparse.Namespace(
+                    input_data_directories=args.validation_data_directories,
+                    input_data_date_range=args.validation_data_date_range,
+                    input_data_days_range=None,
+                )
+                v_paths = game_base.resolve_input_paths(v_args)
+                validation_data, _ = game_base.read_game_data(
+                    v_paths, shard_configs, index_maps, id_tags
+                )
+
+        with Timed("data validation"):
+            mode = DataValidationType[args.data_validation]
+            validate_game_data(data, task, mode)
+            if validation_data is not None:
+                validate_game_data(validation_data, task, mode)
+
+        norm_type = NormalizationType[args.normalization]
+        contexts = None
+        if norm_type != NormalizationType.NONE or args.data_summary_directory:
+            with Timed("feature statistics"):
+                contexts, summaries = _normalization_contexts(
+                    norm_type, data, shard_configs, index_maps
+                )
+            if args.data_summary_directory:
+                _save_summary_stats(
+                    args.data_summary_directory, summaries, index_maps
+                )
+            if norm_type == NormalizationType.NONE:
+                contexts = None
+
+        initial_model = None
+        if args.model_input_directory:
+            from photon_tpu.io.model_io import load_game_model
+
+            with Timed("load initial model"):
+                initial_model = load_game_model(
+                    args.model_input_directory, index_maps
+                )
+
+        estimator = GameEstimator(
+            task=task,
+            coordinate_configs=coordinate_configs,
+            update_sequence=update_sequence,
+            descent_iterations=args.coordinate_descent_iterations,
+            normalization_contexts=contexts,
+            locked_coordinates=locked,
+            validation_evaluator=validation_evaluator,
+        )
+
+        emitter.emit("training_start", task=task.name)
+        with Timed("train"):
+            results = estimator.fit(
+                data,
+                validation_data=validation_data,
+                initial_model=initial_model,
+            )
+
+        tuning_mode = HyperparameterTuningMode[args.hyper_parameter_tuning]
+        if tuning_mode != HyperparameterTuningMode.NONE:
+            if validation_data is None or validation_evaluator is None:
+                raise ValueError(
+                    "hyperparameter tuning requires validation data + an evaluator"
+                )
+            with Timed("hyperparameter tuning"):
+                tuned = run_hyperparameter_tuning(
+                    estimator,
+                    data,
+                    validation_data,
+                    num_iterations=args.hyper_parameter_tuning_iter,
+                    mode=tuning_mode.name,
+                )
+            results = results + tuned
+        emitter.emit("training_finish", num_models=len(results))
+
+        best = _select_best(results, validation_evaluator)
+        log.info(
+            "trained %d models; best #%d (metric=%s)",
+            len(results),
+            best,
+            results[best].evaluation,
+        )
+
+        output_mode = ModelOutputMode[args.output_mode]
+        opt_summary = [
+            {
+                "regularizationWeights": r.regularization_weights,
+                "evaluation": r.evaluation,
+                "wallTimeS": r.wall_time_s,
+            }
+            for r in results
+        ]
+        if output_mode != ModelOutputMode.NONE:
+            with Timed("save models"):
+                if output_mode == ModelOutputMode.ALL:
+                    for i, r in enumerate(results):
+                        save_game_model(
+                            os.path.join(out_root, MODELS_DIR, str(i)),
+                            r.model,
+                            index_maps,
+                            optimization_configurations=r.regularization_weights,
+                            sparsity_threshold=args.model_sparsity_threshold,
+                        )
+                save_game_model(
+                    os.path.join(out_root, BEST_MODEL_DIR),
+                    results[best].model,
+                    index_maps,
+                    optimization_configurations=results[best].regularization_weights,
+                    sparsity_threshold=args.model_sparsity_threshold,
+                )
+        with open(os.path.join(out_root, SUMMARY_FILE), "w") as f:
+            json.dump(
+                {"models": opt_summary, "best": best, "task": task.name}, f, indent=2
+            )
+        emitter.emit("driver_finish")
+    emitter.close()
+    return {"results": results, "best": best, "output": out_root}
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

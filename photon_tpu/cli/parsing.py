@@ -1,0 +1,186 @@
+"""Compound CLI-argument parsing (reference io/scopt/ScoptParserHelpers.scala).
+
+The reference passes structured configs as repeated ``key=value`` lists:
+
+- feature shard:   ``name=global, feature.bags=bag1|bag2, intercept=true``
+- coordinate:      ``name=per-user, random.effect.type=userId,
+                     feature.shard=user, optimizer=LBFGS, max.iter=20,
+                     tolerance=1e-6, regularization=L2, reg.weights=1|10|100,
+                     active.data.lower.bound=2, ...``
+
+Keys match the reference constants (ScoptParserHelpers.scala:39-101);
+secondary lists use ``|``.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from photon_tpu.evaluation.evaluators import EvaluatorType
+from photon_tpu.game.config import (
+    CoordinateConfig,
+    FixedEffectCoordinateConfig,
+    ProjectorType,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.io.data_reader import FeatureShardConfig
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.types import OptimizerType, TaskType
+
+KV_DELIMITER = "="
+LIST_DELIMITER = ","
+SECONDARY_LIST_DELIMITER = "|"
+
+
+def parse_kv(s: str) -> dict[str, str]:
+    """``k1=v1, k2=v2`` → dict (reference ScoptParserHelpers.parseArgs)."""
+    out: dict[str, str] = {}
+    for part in s.split(LIST_DELIMITER):
+        part = part.strip()
+        if not part:
+            continue
+        if KV_DELIMITER not in part:
+            raise ValueError(f"expected key{KV_DELIMITER}value, got {part!r}")
+        k, v = part.split(KV_DELIMITER, 1)
+        k, v = k.strip(), v.strip()
+        if k in out:
+            raise ValueError(f"duplicate key {k!r} in {s!r}")
+        out[k] = v
+    return out
+
+
+def _pop_bool(kv: dict[str, str], key: str, default: bool) -> bool:
+    v = kv.pop(key, None)
+    if v is None:
+        return default
+    if v.lower() in ("true", "1", "yes"):
+        return True
+    if v.lower() in ("false", "0", "no"):
+        return False
+    raise ValueError(f"bad boolean for {key}: {v!r}")
+
+
+def parse_feature_shard_config(s: str) -> tuple[str, FeatureShardConfig]:
+    """One ``--feature-shard-configurations`` instance
+    (reference parseFeatureShardConfiguration :161-164)."""
+    kv = parse_kv(s)
+    try:
+        name = kv.pop("name")
+        bags = tuple(
+            b.strip()
+            for b in kv.pop("feature.bags").split(SECONDARY_LIST_DELIMITER)
+            if b.strip()
+        )
+    except KeyError as e:
+        raise ValueError(f"feature shard config missing {e}") from None
+    intercept = _pop_bool(kv, "intercept", True)
+    if kv:
+        raise ValueError(f"unknown feature shard config keys: {sorted(kv)}")
+    return name, FeatureShardConfig(feature_bags=bags, has_intercept=intercept)
+
+
+def _parse_weights(s: str) -> tuple[float, ...]:
+    ws = tuple(float(w) for w in s.split(SECONDARY_LIST_DELIMITER) if w.strip())
+    if not ws:
+        raise ValueError("empty reg.weights list")
+    return ws
+
+
+def parse_coordinate_config(
+    s: str, task: TaskType
+) -> tuple[str, CoordinateConfig]:
+    """One ``--coordinate-configurations`` instance
+    (reference parseCoordinateConfiguration :190-280)."""
+    kv = parse_kv(s)
+    try:
+        name = kv.pop("name")
+        shard = kv.pop("feature.shard")
+    except KeyError as e:
+        raise ValueError(f"coordinate config missing {e}") from None
+
+    opt_cfg = OptimizerConfig()
+    if "max.iter" in kv:
+        opt_cfg = dataclasses.replace(
+            opt_cfg, max_iterations=int(kv.pop("max.iter"))
+        )
+    if "tolerance" in kv:
+        opt_cfg = dataclasses.replace(
+            opt_cfg, tolerance=float(kv.pop("tolerance"))
+        )
+    optimizer = OptimizerType[kv.pop("optimizer", "LBFGS").upper()]
+
+    reg_type = RegularizationType[kv.pop("regularization", "NONE").upper()]
+    alpha = float(kv.pop("reg.alpha")) if "reg.alpha" in kv else None
+    reg_weights = _parse_weights(kv.pop("reg.weights", "0"))
+
+    problem = GLMProblemConfig(
+        task=task,
+        optimizer=optimizer,
+        optimizer_config=opt_cfg,
+        regularization=RegularizationContext(
+            regularization_type=reg_type, elastic_net_alpha=alpha
+        ),
+        down_sampling_rate=float(kv.pop("down.sampling.rate", "1.0")),
+    )
+
+    re_type = kv.pop("random.effect.type", None)
+    if re_type is None:
+        if any(k.startswith("active.data") or k.startswith("passive") for k in kv):
+            raise ValueError(
+                "active/passive data bounds only apply to random effects"
+            )
+        if kv:
+            raise ValueError(f"unknown coordinate config keys: {sorted(kv)}")
+        return name, FixedEffectCoordinateConfig(
+            feature_shard=shard,
+            optimization=problem,
+            regularization_weights=reg_weights,
+        )
+
+    upper = kv.pop("active.data.upper.bound", None)
+    config = RandomEffectCoordinateConfig(
+        random_effect_type=re_type,
+        feature_shard=shard,
+        optimization=problem,
+        regularization_weights=reg_weights,
+        active_data_lower_bound=int(kv.pop("active.data.lower.bound", "1")),
+        active_data_upper_bound=None if upper is None else int(upper),
+        passive_data_lower_bound=int(kv.pop("passive.data.bound", "0")),
+        features_to_samples_ratio=(
+            float(kv.pop("features.to.samples.ratio"))
+            if "features.to.samples.ratio" in kv
+            else None
+        ),
+        projector_type=ProjectorType[kv.pop("projector.type", "INDEX_MAP").upper()],
+        random_projection_dim=(
+            int(kv.pop("random.projection.dim"))
+            if "random.projection.dim" in kv
+            else None
+        ),
+    )
+    if kv.pop("min.partitions", None):
+        pass  # partition counts are XLA's concern on TPU; accepted for parity
+    if kv:
+        raise ValueError(f"unknown coordinate config keys: {sorted(kv)}")
+    return name, config
+
+
+def parse_evaluators(s: str) -> list[EvaluatorType]:
+    """Comma-separated evaluator list (reference EvaluatorType.withName)."""
+    out = []
+    for tok in s.split(LIST_DELIMITER):
+        tok = tok.strip().upper().replace("-", "_")
+        if not tok:
+            continue
+        try:
+            out.append(EvaluatorType[tok])
+        except KeyError:
+            valid = ", ".join(e.name for e in EvaluatorType)
+            raise ValueError(
+                f"unknown evaluator {tok!r}; expected one of {valid}"
+            ) from None
+    return out

@@ -95,6 +95,22 @@ class GameData:
     def num_samples(self) -> int:
         return self.labels.shape[0]
 
+    def shard_dataset(self, shard: str):
+        """One feature shard + the shared label/offset/weight columns as a
+        flat DataSet (the single-shard view the GLM stack consumes)."""
+        from photon_tpu.data.dataset import DataSet
+
+        m = self.feature_shards[shard]
+        return DataSet(
+            indptr=m.indptr,
+            indices=m.indices,
+            values=m.values,
+            labels=self.labels,
+            offsets=self.offsets,
+            weights=self.weights,
+            num_features=m.num_cols,
+        )
+
     @staticmethod
     def build(
         labels: np.ndarray,

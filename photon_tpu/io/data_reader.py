@@ -41,11 +41,13 @@ def _record_features(record: dict, bags: Sequence[str]):
 
 
 def _record_label(record: dict) -> float:
+    """Label, or NaN when absent — scoring data may be unlabeled; the
+    validators reject non-finite labels on the training path."""
     if "label" in record and record["label"] is not None:
         return float(record["label"])
     if "response" in record and record["response"] is not None:
         return float(record["response"])
-    raise ValueError("record has neither 'label' nor 'response'")
+    return float("nan")
 
 
 def _record_id_tag(record: dict, tag: str) -> str | None:

@@ -461,3 +461,48 @@ def save_scoring_results(
             }
 
     return write_avro_file(path, schemas.SCORING_RESULT_AVRO, gen())
+
+
+def read_model_feature_keys(
+    model_dir: str | os.PathLike,
+    shard_configs: Mapping,
+) -> dict[str, IndexMap]:
+    """Rebuild per-shard index maps from a saved model's own vocabulary.
+
+    Scoring without an off-heap store must place coefficients consistently
+    regardless of the scoring dataset's feature set (the reference ships the
+    training-time map; here the model's coefficient names ARE that map —
+    features absent from the model would score zero anyway).
+    """
+    from photon_tpu.data.index_map import DefaultIndexMap, feature_key
+
+    keys: dict[str, set] = {}
+    root = Path(model_dir)
+    for section in (FIXED_EFFECT, RANDOM_EFFECT):
+        d = root / section
+        if not d.is_dir():
+            continue
+        for cdir in sorted(d.iterdir()):
+            if not cdir.is_dir():
+                continue
+            if (cdir / "projection-matrix.npy").exists():
+                # Random-projection coordinates store coefficients with
+                # positional projected-space names; the original shard
+                # vocabulary cannot be recovered from them.
+                raise ValueError(
+                    f"model coordinate {cdir.name!r} uses a random "
+                    "projection; scoring it requires the training-time "
+                    "feature index (--off-heap-index-map-dir)"
+                )
+            lines = (cdir / ID_INFO).read_text().strip().splitlines()
+            shard = lines[0] if section == FIXED_EFFECT else lines[1]
+            bucket = keys.setdefault(shard, set())
+            for rec in read_avro_dir(cdir / COEFFICIENTS):
+                for ntv in (rec.get("means") or []) + (rec.get("variances") or []):
+                    bucket.add(feature_key(ntv["name"], ntv.get("term") or ""))
+    out: dict[str, IndexMap] = {}
+    for shard, ks in keys.items():
+        cfg = shard_configs.get(shard)
+        has_intercept = True if cfg is None else cfg.has_intercept
+        out[shard] = DefaultIndexMap.from_keys(ks, add_intercept=has_intercept)
+    return out

@@ -1,0 +1,467 @@
+"""Driver-level integration tests (reference GameTrainingDriverIntegTest /
+GameScoringDriverIntegTest / DriverTest / FeatureIndexingDriverIntegTest):
+run the CLIs end-to-end on small synthetic fixture data and assert on the
+saved artifacts."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.cli import (
+    feature_indexing,
+    game_scoring,
+    game_training,
+    legacy_driver,
+    name_term_bags,
+)
+from photon_tpu.cli.parsing import (
+    parse_coordinate_config,
+    parse_evaluators,
+    parse_feature_shard_config,
+    parse_kv,
+)
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.io.avro import read_avro_file, write_avro_file
+from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+from photon_tpu.types import OptimizerType, TaskType
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+N_USERS = 8
+D_FIXED = 6
+
+
+def _make_records(seed=0, n=400):
+    """GLMix logistic data: global effect + per-user effect on one shared
+    feature bag, userId carried in metadataMap."""
+    w_rng = np.random.default_rng(42)  # same true model for every split
+    w_global = w_rng.normal(size=D_FIXED)
+    w_user = w_rng.normal(size=(N_USERS, D_FIXED)) * 2.0
+    rng = np.random.default_rng(seed)
+    records = []
+    for i in range(n):
+        u = int(rng.integers(N_USERS))
+        x = rng.normal(size=D_FIXED)
+        margin = x @ (w_global + w_user[u])
+        y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+        records.append(
+            {
+                "uid": f"s{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(D_FIXED)
+                ],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+        )
+    return records
+
+
+@pytest.fixture(scope="module")
+def avro_data(tmp_path_factory):
+    root = tmp_path_factory.mktemp("avro-fixture")
+    train_dir = root / "train"
+    valid_dir = root / "valid"
+    train_dir.mkdir()
+    valid_dir.mkdir()
+    write_avro_file(
+        train_dir / "part-00000.avro", TRAINING_EXAMPLE_AVRO, _make_records(0)
+    )
+    write_avro_file(
+        valid_dir / "part-00000.avro",
+        TRAINING_EXAMPLE_AVRO,
+        _make_records(1, n=200),
+    )
+    return root
+
+
+SHARD_ARG = "name=global,feature.bags=features"
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_kv_and_errors():
+    assert parse_kv("a=1, b=x|y") == {"a": "1", "b": "x|y"}
+    with pytest.raises(ValueError):
+        parse_kv("a=1,a=2")
+    with pytest.raises(ValueError):
+        parse_kv("noequals")
+
+
+def test_parse_feature_shard_config():
+    name, cfg = parse_feature_shard_config(
+        "name=user,feature.bags=userFeatures|songFeatures,intercept=false"
+    )
+    assert name == "user"
+    assert cfg.feature_bags == ("userFeatures", "songFeatures")
+    assert not cfg.has_intercept
+    with pytest.raises(ValueError):
+        parse_feature_shard_config("feature.bags=x")
+    with pytest.raises(ValueError):
+        parse_feature_shard_config("name=a,feature.bags=x,bogus=1")
+
+
+def test_parse_coordinate_config_fixed_and_random():
+    name, cfg = parse_coordinate_config(
+        "name=global,feature.shard=global,optimizer=TRON,max.iter=7,"
+        "tolerance=1e-4,regularization=L2,reg.weights=0.1|1|10,"
+        "down.sampling.rate=0.5",
+        TaskType.LINEAR_REGRESSION,
+    )
+    assert name == "global"
+    assert isinstance(cfg, FixedEffectCoordinateConfig)
+    assert cfg.optimization.optimizer == OptimizerType.TRON
+    assert cfg.optimization.optimizer_config.max_iterations == 7
+    assert cfg.regularization_weights == (0.1, 1.0, 10.0)
+    assert cfg.optimization.down_sampling_rate == 0.5
+
+    name, cfg = parse_coordinate_config(
+        "name=per-user,random.effect.type=userId,feature.shard=user,"
+        "regularization=ELASTIC_NET,reg.alpha=0.3,reg.weights=1,"
+        "active.data.lower.bound=2,active.data.upper.bound=64,"
+        "passive.data.bound=8,features.to.samples.ratio=3.5",
+        TaskType.LOGISTIC_REGRESSION,
+    )
+    assert isinstance(cfg, RandomEffectCoordinateConfig)
+    assert cfg.random_effect_type == "userId"
+    assert cfg.active_data_upper_bound == 64
+    assert cfg.features_to_samples_ratio == 3.5
+    assert cfg.optimization.regularization.elastic_net_alpha == 0.3
+
+    with pytest.raises(ValueError):  # RE-only key on a fixed coordinate
+        parse_coordinate_config(
+            "name=x,feature.shard=s,active.data.lower.bound=2",
+            TaskType.LOGISTIC_REGRESSION,
+        )
+
+
+def test_parse_evaluators():
+    assert parse_evaluators("AUC, RMSE") == [
+        parse_evaluators("AUC")[0],
+        parse_evaluators("RMSE")[0],
+    ]
+    with pytest.raises(ValueError):
+        parse_evaluators("NOPE")
+
+
+# ---------------------------------------------------------------------------
+# index / bag drivers
+# ---------------------------------------------------------------------------
+
+
+def test_feature_indexing_and_bags_drivers(avro_data, tmp_path):
+    out = tmp_path / "index"
+    res = feature_indexing.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--feature-shard-configurations", SHARD_ARG,
+            "--root-output-directory", str(out),
+            "--num-partitions", "2",
+        ]
+    )
+    # D_FIXED features + intercept
+    assert res["shards"]["global"] == D_FIXED + 1
+
+    from photon_tpu.data.index_map import feature_key
+    from photon_tpu.data.native_index import load_partitioned_store
+
+    store = load_partitioned_store(out, "global")
+    assert len(store) == D_FIXED + 1
+    seen = set()
+    for j in range(D_FIXED):
+        idx = store.get_index(feature_key(f"f{j}"))
+        assert idx >= 0
+        seen.add(idx)
+    assert len(seen) == D_FIXED  # distinct global indices across partitions
+
+    bags_out = tmp_path / "bags"
+    res = name_term_bags.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--feature-bags", "features",
+            "--root-output-directory", str(bags_out),
+        ]
+    )
+    assert res["counts"]["features"] == D_FIXED
+    tsv = (bags_out / "features" / "name-terms.tsv").read_text().splitlines()
+    assert len(tsv) == D_FIXED
+
+
+# ---------------------------------------------------------------------------
+# GAME training + scoring drivers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trained_model_dir(avro_data, tmp_path_factory):
+    out = tmp_path_factory.mktemp("game-out")
+    res = game_training.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--validation-data-directories", str(avro_data / "valid"),
+            "--root-output-directory", str(out / "training"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=30,"
+            "regularization=L2,reg.weights=1|10",
+            "--coordinate-configurations",
+            "name=per-user,random.effect.type=userId,feature.shard=global,"
+            "max.iter=15,regularization=L2,reg.weights=1",
+            "--coordinate-update-sequence", "global,per-user",
+            "--coordinate-descent-iterations", "2",
+            "--evaluators", "AUC",
+            "--output-mode", "ALL",
+        ]
+    )
+    return out / "training", res
+
+
+def test_game_training_driver_artifacts(trained_model_dir):
+    out, res = trained_model_dir
+    assert len(res["results"]) == 2  # λ grid of length 2
+    summary = json.loads((out / "training-summary.json").read_text())
+    assert summary["best"] == res["best"]
+    assert len(summary["models"]) == 2
+    # both AUCs computed and sane
+    for m in summary["models"]:
+        assert 0.5 < m["evaluation"] <= 1.0
+
+    best = out / "best"
+    assert (best / "fixed-effect" / "global" / "id-info").exists()
+    assert (best / "random-effect" / "per-user" / "id-info").exists()
+    assert (out / "models" / "0" / "model-metadata.json").exists()
+    assert (out / "models" / "1" / "model-metadata.json").exists()
+    assert (out / "driver.log").exists()
+
+
+def test_game_scoring_driver(avro_data, trained_model_dir, tmp_path):
+    out, _ = trained_model_dir
+    score_out = tmp_path / "scoring"
+    res = game_scoring.run(
+        [
+            "--input-data-directories", str(avro_data / "valid"),
+            "--root-output-directory", str(score_out),
+            "--feature-shard-configurations", SHARD_ARG,
+            "--model-input-directory", str(out / "best"),
+            "--evaluators", "AUC,LOGISTIC_LOSS",
+            "--model-id", "m1",
+        ]
+    )
+    assert 0.6 < res["evaluations"]["AUC"] <= 1.0
+    records = list(
+        read_avro_file(score_out / "scores" / "part-00000.avro")
+    )
+    assert len(records) == 200
+    assert records[0]["modelId"] == "m1"
+    assert all(np.isfinite(r["predictionScore"]) for r in records)
+    # scores in the avro match the returned array
+    np.testing.assert_allclose(
+        [r["predictionScore"] for r in records[:10]], res["scores"][:10],
+        rtol=1e-6,
+    )
+
+
+def test_game_training_with_offheap_index(avro_data, tmp_path):
+    index_out = tmp_path / "index"
+    feature_indexing.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--feature-shard-configurations", SHARD_ARG,
+            "--root-output-directory", str(index_out),
+            "--num-partitions", "2",
+        ]
+    )
+    out = tmp_path / "train-offheap"
+    res = game_training.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--root-output-directory", str(out),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--off-heap-index-map-dir", str(index_out),
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,max.iter=10,regularization=L2,"
+            "reg.weights=1",
+            "--coordinate-update-sequence", "global",
+        ]
+    )
+    assert (out / "best" / "fixed-effect" / "global").is_dir()
+    assert len(res["results"]) == 1
+
+
+def test_scoring_unlabeled_data_skips_evaluators(trained_model_dir, tmp_path):
+    out, _ = trained_model_dir
+    data_dir = tmp_path / "unlabeled"
+    data_dir.mkdir()
+    recs = _make_records(2, n=50)
+    for r in recs:
+        del r["label"]
+    # label is non-nullable in TrainingExampleAvro; use a schema without it
+    schema = {
+        **TRAINING_EXAMPLE_AVRO,
+        "fields": [
+            f for f in TRAINING_EXAMPLE_AVRO["fields"] if f["name"] != "label"
+        ],
+    }
+    write_avro_file(data_dir / "part-00000.avro", schema, recs)
+    res = game_scoring.run(
+        [
+            "--input-data-directories", str(data_dir),
+            "--root-output-directory", str(tmp_path / "sout"),
+            "--feature-shard-configurations", SHARD_ARG,
+            "--model-input-directory", str(out / "best"),
+            "--evaluators", "AUC",
+        ]
+    )
+    assert res["evaluations"] == {}  # no labels → no metrics
+    assert len(res["scores"]) == 50
+    assert np.all(np.isfinite(res["scores"]))
+
+
+def test_game_training_validates_validation_data(avro_data, tmp_path):
+    bad_dir = tmp_path / "bad-valid"
+    bad_dir.mkdir()
+    recs = _make_records(3, n=20)
+    recs[5]["features"][0]["value"] = float("nan")
+    write_avro_file(bad_dir / "part-00000.avro", TRAINING_EXAMPLE_AVRO, recs)
+    from photon_tpu.data.validators import DataValidationError
+
+    with pytest.raises(DataValidationError, match="non-finite"):
+        game_training.run(
+            [
+                "--input-data-directories", str(avro_data / "train"),
+                "--validation-data-directories", str(bad_dir),
+                "--root-output-directory", str(tmp_path / "vt"),
+                "--training-task", "LOGISTIC_REGRESSION",
+                "--feature-shard-configurations", SHARD_ARG,
+                "--coordinate-configurations",
+                "name=global,feature.shard=global,max.iter=5,reg.weights=1",
+                "--coordinate-update-sequence", "global",
+                "--evaluators", "AUC",
+            ]
+        )
+
+
+def test_game_training_rejects_unknown_shard(avro_data, tmp_path):
+    with pytest.raises(ValueError, match="unknown shards"):
+        game_training.run(
+            [
+                "--input-data-directories", str(avro_data / "train"),
+                "--root-output-directory", str(tmp_path / "x"),
+                "--training-task", "LOGISTIC_REGRESSION",
+                "--feature-shard-configurations", SHARD_ARG,
+                "--coordinate-configurations",
+                "name=global,feature.shard=nope,reg.weights=1",
+                "--coordinate-update-sequence", "global",
+            ]
+        )
+
+
+# ---------------------------------------------------------------------------
+# legacy driver
+# ---------------------------------------------------------------------------
+
+
+def _write_libsvm(path, seed=0, n=300, d=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-X @ w))).astype(int)
+    with open(path, "w") as f:
+        for i in range(n):
+            feats = " ".join(f"{j + 1}:{X[i, j]:.6f}" for j in range(d))
+            f.write(f"{2 * y[i] - 1} {feats}\n")
+
+
+def test_legacy_driver_staged_pipeline(tmp_path):
+    train = tmp_path / "a1a.libsvm"
+    valid = tmp_path / "a1a.t.libsvm"
+    _write_libsvm(train, 0)
+    _write_libsvm(valid, 1)
+    out = tmp_path / "out"
+    driver = legacy_driver.run(
+        [
+            "--training-data-directory", str(train),
+            "--validating-data-directory", str(valid),
+            "--output-directory", str(out),
+            "--input-format", "LIBSVM",
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-type", "L2",
+            "--regularization-weights", "0.1,1,10",
+            "--normalization-type", "STANDARDIZATION",
+            "--max-num-iterations", "50",
+        ]
+    )
+    assert [s.name for s in driver.stage_history] == [
+        "INIT",
+        "PREPROCESSED",
+        "TRAINED",
+    ]
+    assert driver.stage.name == "VALIDATED"
+    assert len(driver.models) == 3
+    metrics = json.loads((out / "metrics.json").read_text())
+    assert len(metrics["metrics"]) == 3
+    assert [r["Lambda"] for r in metrics["metrics"]] == [0.1, 1.0, 10.0]
+    for row in metrics["metrics"]:
+        assert 0.5 < row["AUC"] <= 1.0
+    assert metrics["bestIndex"] == driver.best_index
+    text = (out / "best-model-text" / "best.txt").read_text()
+    assert text.startswith("# lambda=")
+    assert len(text.splitlines()) > 2
+
+
+def test_legacy_driver_stage_assertions(tmp_path):
+    train = tmp_path / "t.libsvm"
+    _write_libsvm(train)
+    args = legacy_driver.build_parser().parse_args(
+        [
+            "--training-data-directory", str(train),
+            "--output-directory", str(tmp_path / "o"),
+            "--input-format", "LIBSVM",
+            "--task", "LOGISTIC_REGRESSION",
+        ]
+    )
+    d = legacy_driver.LegacyDriver(args)
+    with pytest.raises(RuntimeError, match="stage assertion"):
+        d.train()  # must preprocess first
+
+
+def test_legacy_driver_avro_input(avro_data, tmp_path):
+    out = tmp_path / "avro-out"
+    driver = legacy_driver.run(
+        [
+            "--training-data-directory", str(avro_data / "train"),
+            "--output-directory", str(out),
+            "--input-format", "AVRO",
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-type", "L2",
+            "--regularization-weights", "1",
+        ]
+    )
+    assert driver.stage.name == "VALIDATED"
+    # avro path carries feature names through to the text output
+    text = (
+        out / "learned-models-text" / "lambda-1.0.txt"
+    ).read_text()
+    assert "f0" in text
+    # and writes a loadable avro model
+    from photon_tpu.data.index_map import DefaultIndexMap
+    from photon_tpu.io.model_io import load_glm
+
+    imap = driver.index_maps["global"]
+    model, _ = load_glm(out / "models" / "lambda-1.0.avro", imap)
+    assert model.coefficients.means.shape[0] == len(imap)
