@@ -169,6 +169,7 @@ class LegacyDriver:
                 elastic_net_alpha=a.elastic_net_alpha,
             ),
         )
+        self.problem_config = config
         weights = [float(w) for w in a.regularization_weights.split(",")]
         with Timed("train GLM grid"):
             self.models = train_glm_grid(
@@ -215,6 +216,11 @@ class LegacyDriver:
         from photon_tpu.diagnostics import diagnose_models
 
         data = self.validation_data or self.train_data
+        index_to_name = (
+            self.index_maps.get("global")
+            if getattr(self, "index_maps", None)
+            else None
+        )
         with Timed("diagnostics"):
             self.diagnostics_report = diagnose_models(
                 self.models,
@@ -222,6 +228,10 @@ class LegacyDriver:
                 TaskType[self.args.task],
                 output_dir=os.path.join(self.args.output_directory, "diagnostics"),
                 train_data=self.train_data,
+                config=self.problem_config,
+                normalization=self.normalization,
+                best_index=self.best_index,
+                index_to_name=index_to_name,
             )
         self._advance(DriverStage.DIAGNOSED)
 
@@ -352,10 +362,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(argv=None) -> LegacyDriver:
     args = build_parser().parse_args(argv)
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        import jax
+    from photon_tpu.cli.game_base import ensure_single_process_jax
 
-        jax.config.update("jax_platforms", "cpu")
+    ensure_single_process_jax()
     prepare_output_dir(
         args.output_directory, override=args.override_output_directory
     )

@@ -1,0 +1,93 @@
+"""Fitting (learning-curve) diagnostic: train/test metrics vs training-set
+fraction.
+
+Reference: photon-diagnostics fitting/FittingDiagnostic.scala:33-128 — train
+on growing prefixes of the training data and plot train vs holdout metric
+curves; a widening gap diagnoses overfitting, twin high plateaus diagnose
+underfitting.
+
+TPU-native design: "training on a fraction" is weight-masking a fixed random
+permutation prefix, so every fraction reuses the same resident [N, D] device
+block and the same compiled solve — no data movement between fractions.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_tpu.optimize.problem import GLMProblemConfig
+from photon_tpu.types import LabeledBatch, TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class FittingReport:
+    fractions: list[float]
+    #: metric name → per-fraction value on the (masked) training portion
+    train_metrics: dict[str, list[float]]
+    #: metric name → per-fraction value on the holdout set
+    test_metrics: dict[str, list[float]]
+
+
+def fitting_diagnostic(
+    train_batch: LabeledBatch,
+    test_batch: LabeledBatch,
+    config: GLMProblemConfig,
+    task: TaskType,
+    *,
+    num_samples: int,
+    num_test_samples: int | None = None,
+    fractions: list[float] | None = None,
+    normalization=None,
+    seed: int = 0,
+) -> FittingReport:
+    import jax.numpy as jnp
+
+    from photon_tpu.diagnostics.metrics import compute_metrics
+    from photon_tpu.model_training import train_glm_grid
+
+    fractions = fractions or [0.25, 0.5, 0.75, 1.0]
+    norm_kw = {} if normalization is None else {"normalization": normalization}
+    rng = np.random.default_rng(seed)
+    n_total = int(train_batch.labels.shape[0])
+    perm = rng.permutation(num_samples)
+    base_weights = np.asarray(train_batch.weights, dtype=np.float64)
+
+    train_metrics: dict[str, list[float]] = {}
+    test_metrics: dict[str, list[float]] = {}
+    warm = None
+    for frac in fractions:
+        take = max(int(round(frac * num_samples)), 1)
+        mask = np.zeros(n_total)
+        mask[perm[:take]] = 1.0
+        masked = train_batch._replace(
+            weights=jnp.asarray(
+                base_weights * mask, dtype=train_batch.weights.dtype
+            )
+        )
+        [tm] = train_glm_grid(
+            masked,
+            config,
+            [config.regularization_weight],
+            warm_start=False,
+            initial_coefficients=warm,
+            **norm_kw,
+        )
+        warm = jnp.asarray(
+            np.asarray(tm.model.coefficients.means),
+            dtype=train_batch.features.dtype,
+        )
+        on_train = compute_metrics(tm.model, masked, task, num_samples=n_total)
+        on_test = compute_metrics(
+            tm.model, test_batch, task, num_samples=num_test_samples
+        )
+        for name, v in on_train.items():
+            train_metrics.setdefault(name, []).append(v)
+        for name, v in on_test.items():
+            test_metrics.setdefault(name, []).append(v)
+
+    return FittingReport(
+        fractions=list(fractions),
+        train_metrics=train_metrics,
+        test_metrics=test_metrics,
+    )

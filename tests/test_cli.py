@@ -424,6 +424,32 @@ def test_legacy_driver_staged_pipeline(tmp_path):
     assert len(text.splitlines()) > 2
 
 
+def test_legacy_driver_diagnose_stage(tmp_path):
+    train = tmp_path / "train.libsvm"
+    valid = tmp_path / "valid.libsvm"
+    _write_libsvm(train, 0, n=200, d=4)
+    _write_libsvm(valid, 1, n=200, d=4)
+    out = tmp_path / "out"
+    driver = legacy_driver.run(
+        [
+            "--training-data-directory", str(train),
+            "--validating-data-directory", str(valid),
+            "--output-directory", str(out),
+            "--input-format", "LIBSVM",
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-type", "L2",
+            "--regularization-weights", "1",
+            "--max-num-iterations", "30",
+            "--diagnose",
+        ]
+    )
+    assert driver.stage.name == "DIAGNOSED"
+    assert driver.diagnostics_report is not None
+    entry = driver.diagnostics_report["models"][0]
+    assert "hosmer_lemeshow" in entry
+    assert (out / "diagnostics" / "report.html").exists()
+
+
 def test_legacy_driver_stage_assertions(tmp_path):
     train = tmp_path / "t.libsvm"
     _write_libsvm(train)

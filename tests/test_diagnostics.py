@@ -1,0 +1,320 @@
+"""Diagnostics suite tests — reference photon-diagnostics analogues:
+HL calibration (HosmerLemeshowDiagnostic), Kendall-τ independence
+(KendallTauAnalysis), bootstrap CIs (BootstrapTrainingDiagnostic), learning
+curves (FittingDiagnostic), metrics map (Evaluation.scala), and the HTML
+report pipeline (reporting/).
+"""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import DataSet, to_device_batch
+from photon_tpu.diagnostics import diagnose_models
+from photon_tpu.diagnostics.bootstrap import bootstrap_diagnostic
+from photon_tpu.diagnostics.fitting import fitting_diagnostic
+from photon_tpu.diagnostics.hl import chi_square_sf, hosmer_lemeshow
+from photon_tpu.diagnostics.importance import importance_from_batch
+from photon_tpu.diagnostics.independence import (
+    kendall_tau,
+    prediction_error_independence,
+)
+from photon_tpu.diagnostics.metrics import (
+    AREA_UNDER_ROC,
+    DATA_LOG_LIKELIHOOD,
+    MEAN_ABSOLUTE_ERROR,
+    MEAN_SQUARED_ERROR,
+    PEAK_F1,
+    ROOT_MEAN_SQUARED_ERROR,
+    compute_metrics,
+    peak_f1,
+)
+from photon_tpu.diagnostics.reporting import (
+    BarChart,
+    Chapter,
+    Document,
+    LineChart,
+    Section,
+    Table,
+    Text,
+    render_html,
+    render_text,
+)
+from photon_tpu.model_training import train_glm_grid
+from photon_tpu.models.glm import LinearRegressionModel, LogisticRegressionModel
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.optimize.problem import GLMProblemConfig
+from photon_tpu.types import TaskType
+
+
+def _logistic_data(n=4000, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    p = 1.0 / (1.0 + np.exp(-(x @ w)))
+    y = (rng.uniform(size=n) < p).astype(np.float64)
+    return x, y, p, w
+
+
+# ---------------------------------------------------------------- χ² / HL
+
+
+def test_chi_square_sf_matches_scipy():
+    from scipy.stats import chi2
+
+    for df in (1, 4, 8):
+        for x in (0.5, 3.0, 15.0):
+            assert chi_square_sf(x, df) == pytest.approx(
+                chi2.sf(x, df), rel=1e-10
+            )
+
+
+def test_hosmer_lemeshow_calibrated_accepts_miscalibrated_rejects():
+    _, y, p, _ = _logistic_data(n=8000, seed=1)
+    good = hosmer_lemeshow(p, y)
+    assert good.p_value > 0.05
+    assert good.well_calibrated
+
+    bad = hosmer_lemeshow(p**3, y)  # systematically distorted probabilities
+    assert bad.chi_square > good.chi_square
+    assert bad.p_value < 0.01
+
+
+def test_hosmer_lemeshow_bin_accounting():
+    p = np.array([0.05, 0.15, 0.95, 0.85])
+    y = np.array([0.0, 1.0, 1.0, 1.0])
+    rep = hosmer_lemeshow(p, y, num_bins=10)
+    assert sum(b.count for b in rep.bins) == pytest.approx(4.0)
+    assert sum(b.observed_pos for b in rep.bins) == pytest.approx(3.0)
+    assert sum(b.expected_pos for b in rep.bins) == pytest.approx(np.sum(p))
+
+
+# ---------------------------------------------------------------- Kendall τ
+
+
+def test_kendall_tau_matches_scipy():
+    from scipy.stats import kendalltau
+
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=300)
+    b = 0.5 * a + rng.normal(size=300)
+    rep = kendall_tau(a, b)
+    ref_tau, _ = kendalltau(a, b)
+    assert rep.tau == pytest.approx(ref_tau, abs=1e-12)
+
+
+def test_kendall_tau_detects_dependence_and_independence():
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=500)
+    rep_ind = kendall_tau(a, rng.normal(size=500))
+    assert rep_ind.p_value > 0.05
+    rep_dep = kendall_tau(a, 2.0 * a + 1.0)
+    assert rep_dep.tau == pytest.approx(1.0)
+    assert rep_dep.p_value < 1e-6
+
+
+def test_prediction_error_independence_flags_misspecification():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=2500)
+    y = x + 0.5 * x**3  # nonlinear truth
+    preds = x  # linear model: error correlates with prediction
+    rep = prediction_error_independence(preds, y)
+    assert not rep.errors_independent
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_peak_f1_separable_and_bruteforce():
+    scores = np.array([-2.0, -1.0, 1.0, 2.0])
+    labels = np.array([0.0, 0.0, 1.0, 1.0])
+    w = np.ones(4)
+    assert peak_f1(scores, labels, w) == pytest.approx(1.0)
+
+    rng = np.random.default_rng(5)
+    scores = rng.normal(size=60)
+    labels = (rng.uniform(size=60) < 0.4).astype(float)
+    w = rng.uniform(0.5, 2.0, size=60)
+    best = 0.0
+    for t in scores:
+        pred = scores >= t
+        tp = np.sum(w * pred * labels)
+        fp = np.sum(w * pred * (1 - labels))
+        fn = np.sum(w * (~pred) * labels)
+        if 2 * tp + fp + fn > 0:
+            best = max(best, 2 * tp / (2 * tp + fp + fn))
+    assert peak_f1(scores, labels, w) == pytest.approx(best, rel=1e-12)
+
+
+def test_compute_metrics_closed_forms():
+    # Linear model with known coefficients: metrics vs direct numpy.
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(200, 3))
+    w = np.array([1.0, -2.0, 0.5])
+    y = x @ w + rng.normal(scale=0.3, size=200)
+    ds = DataSet.from_dense(x, y)
+    batch = to_device_batch(ds)
+    model = LinearRegressionModel(Coefficients(means=jnp.asarray(w)))
+    m = compute_metrics(
+        model, batch, TaskType.LINEAR_REGRESSION, num_samples=200
+    )
+    pred = x @ w
+    assert m[MEAN_ABSOLUTE_ERROR] == pytest.approx(
+        np.mean(np.abs(pred - y)), rel=1e-6
+    )
+    assert m[MEAN_SQUARED_ERROR] == pytest.approx(
+        np.mean((pred - y) ** 2), rel=1e-6
+    )
+    assert m[ROOT_MEAN_SQUARED_ERROR] == pytest.approx(
+        np.sqrt(m[MEAN_SQUARED_ERROR])
+    )
+    assert np.isfinite(m[DATA_LOG_LIKELIHOOD])
+
+
+def test_compute_metrics_logistic_separable():
+    x = np.array([[-3.0], [-2.0], [2.0], [3.0]])
+    y = np.array([0.0, 0.0, 1.0, 1.0])
+    ds = DataSet.from_dense(x, y)
+    batch = to_device_batch(ds)
+    model = LogisticRegressionModel(Coefficients(means=jnp.asarray([5.0])))
+    m = compute_metrics(
+        model, batch, TaskType.LOGISTIC_REGRESSION, num_samples=4
+    )
+    assert m[AREA_UNDER_ROC] == pytest.approx(1.0)
+    assert m[PEAK_F1] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------- importance
+
+
+def test_feature_importance_ranks_dominant_feature():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(500, 4))
+    ds = DataSet.from_dense(x, np.zeros(500))
+    batch = to_device_batch(ds)
+    coefs = np.array([0.01, 5.0, 0.1, 0.0])
+    rep = importance_from_batch(
+        coefs, batch.features, batch.weights, num_samples=500, top_k=4
+    )
+    assert rep.ranked[0].index == 1
+    assert rep.cumulative_share[-1] == pytest.approx(1.0)
+    assert all(
+        a <= b + 1e-12
+        for a, b in zip(rep.cumulative_share, rep.cumulative_share[1:])
+    )
+
+
+# ---------------------------------------------------------------- bootstrap
+
+
+def test_bootstrap_intervals_cover_strong_coefficients():
+    rng = np.random.default_rng(8)
+    n, d = 600, 3
+    x = rng.normal(size=(n, d))
+    w_true = np.array([2.0, -1.5, 0.0])
+    y = x @ w_true + rng.normal(scale=0.2, size=n)
+    batch = to_device_batch(DataSet.from_dense(x, y))
+    config = GLMProblemConfig(task=TaskType.LINEAR_REGRESSION)
+    rep = bootstrap_diagnostic(
+        batch,
+        batch,
+        config,
+        TaskType.LINEAR_REGRESSION,
+        num_samples=n,
+        num_validation_samples=n,
+        num_replicates=8,
+        seed=0,
+    )
+    by_index = {iv.index: iv for iv in rep.intervals}
+    for j in (0, 1):
+        iv = by_index[j]
+        assert iv.lower <= w_true[j] <= iv.upper
+        assert iv.significant
+    assert rep.metric_distributions  # non-empty metric spread
+
+
+# ---------------------------------------------------------------- fitting
+
+
+def test_fitting_curves_improve_with_data():
+    rng = np.random.default_rng(9)
+    n, d = 800, 8
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = x @ w_true + rng.normal(scale=0.5, size=n)
+    train = to_device_batch(DataSet.from_dense(x[:600], y[:600]))
+    test = to_device_batch(DataSet.from_dense(x[600:], y[600:]))
+    config = GLMProblemConfig(task=TaskType.LINEAR_REGRESSION)
+    rep = fitting_diagnostic(
+        train,
+        test,
+        config,
+        TaskType.LINEAR_REGRESSION,
+        num_samples=600,
+        num_test_samples=200,
+        fractions=[0.1, 1.0],
+    )
+    curve = rep.test_metrics[ROOT_MEAN_SQUARED_ERROR]
+    assert curve[-1] <= curve[0] + 1e-6  # more data never hurts here
+
+
+# ---------------------------------------------------------------- reporting
+
+
+def test_report_rendering_roundtrip(tmp_path):
+    doc = Document(
+        "t",
+        [
+            Chapter(
+                "c",
+                [
+                    Section(
+                        "s",
+                        [
+                            Text("hello <world>"),
+                            Table(["a", "b"], [["1", "2"]]),
+                            LineChart(
+                                "lc", "x", "y", [0.0, 1.0], {"s1": [1.0, 2.0]}
+                            ),
+                            BarChart("bc", ["f1", "f2"], [3.0, -1.0]),
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+    page = render_html(doc)
+    assert "hello &lt;world&gt;" in page
+    assert "<svg" in page and "polyline" in page and "<rect" in page
+    txt = render_text(doc)
+    assert "[chart: lc]" in txt
+
+
+def test_diagnose_models_end_to_end(tmp_path):
+    x, y, _, _ = _logistic_data(n=400, d=4, seed=10)
+    ds = DataSet.from_dense(x, y)
+    config = GLMProblemConfig(task=TaskType.LOGISTIC_REGRESSION)
+    models = train_glm_grid(ds, config, [1.0, 0.1])
+    out = str(tmp_path / "diag")
+    report = diagnose_models(
+        models,
+        ds,
+        TaskType.LOGISTIC_REGRESSION,
+        output_dir=out,
+        train_data=ds,
+        config=config,
+        best_index=1,
+        bootstrap_replicates=4,
+        fitting_fractions=(0.5, 1.0),
+    )
+    assert len(report["models"]) == 2
+    for entry in report["models"]:
+        assert AREA_UNDER_ROC in entry["metrics"]
+        assert "hosmer_lemeshow" in entry
+        assert "error_independence" in entry
+    assert "fitting" in report and "bootstrap" in report
+    assert os.path.exists(os.path.join(out, "report.html"))
+    assert os.path.exists(os.path.join(out, "report.json"))
+    page = open(os.path.join(out, "report.html")).read()
+    assert "Hosmer" in page and "Bootstrap" in page
