@@ -76,6 +76,7 @@ class FixedEffectCoordinate(Coordinate):
         normalization: NormalizationContext = NormalizationContext(),
         dtype=jnp.float32,
         seed: int = 0,
+        mesh=None,
     ) -> "FixedEffectCoordinate":
         shard = data.feature_shards[config.feature_shard]
         weights = data.weights
@@ -96,11 +97,31 @@ class FixedEffectCoordinate(Coordinate):
             else:
                 weights[~keep_draw] = 0.0
         batch = LabeledBatch(
-            features=jnp.asarray(shard.to_dense(), dtype=dtype),
-            labels=jnp.asarray(data.labels, dtype=dtype),
-            offsets=jnp.asarray(data.offsets, dtype=dtype),
-            weights=jnp.asarray(weights, dtype=dtype),
+            features=shard.to_dense(),
+            labels=data.labels,
+            offsets=data.offsets,
+            weights=weights,
         )
+        if mesh is not None:
+            from photon_tpu.parallel.mesh import shard_batch
+
+            # Rows over every mesh device; in-jit gradient reductions become
+            # psum over ICI (the reference's treeAggregate, SURVEY §5.8).
+            # device_put straight from host numpy so no single device ever
+            # holds the whole [N, D] block.
+            batch = shard_batch(batch._replace(
+                features=np.asarray(batch.features, dtype=dtype),
+                labels=np.asarray(batch.labels, dtype=dtype),
+                offsets=np.asarray(batch.offsets, dtype=dtype),
+                weights=np.asarray(batch.weights, dtype=dtype),
+            ), mesh)
+        else:
+            batch = LabeledBatch(
+                features=jnp.asarray(batch.features, dtype=dtype),
+                labels=jnp.asarray(batch.labels, dtype=dtype),
+                offsets=jnp.asarray(batch.offsets, dtype=dtype),
+                weights=jnp.asarray(batch.weights, dtype=dtype),
+            )
         problem = GLMProblem.build(
             config.optimization.with_regularization_weight(
                 config.regularization_weights[0]
@@ -188,19 +209,60 @@ class RandomEffectCoordinate(Coordinate):
         dataset: RandomEffectDataset,
         config: RandomEffectCoordinateConfig,
         dtype=jnp.float32,
+        mesh=None,
     ) -> "RandomEffectCoordinate":
+        entity_shards = 1
+        put_entities = lambda x: x  # noqa: E731
+        if mesh is not None:
+            from photon_tpu.parallel.mesh import (
+                ENTITY_AXIS,
+                pad_rows_to_multiple,
+                shard_entities,
+            )
+
+            entity_shards = mesh.shape[ENTITY_AXIS]
+            put_entities = lambda x: shard_entities(x, mesh)  # noqa: E731
+
         device_buckets = []
         for b in dataset.buckets:
+            # Pad the entity axis so it divides the mesh's entity dimension;
+            # padded lanes carry zero weights and the OOB sample slot, so
+            # they train to zero instantly and score nothing.
+            e = b.num_entities
+            e_pad = (
+                0
+                if entity_shards == 1
+                else pad_rows_to_multiple(e, entity_shards) - e
+            )
+
+            def pad_e(x, fill=0):
+                if e_pad == 0:
+                    return x
+                widths = [(0, e_pad)] + [(0, 0)] * (x.ndim - 1)
+                return np.pad(x, widths, constant_values=fill)
+
             device_buckets.append(
                 _DeviceBucket(
-                    features=jnp.asarray(b.features, dtype=dtype),
-                    labels=jnp.asarray(b.labels, dtype=dtype),
-                    offsets=jnp.asarray(b.offsets, dtype=dtype),
-                    weights=jnp.asarray(b.weights, dtype=dtype),
-                    train_weights=jnp.asarray(
-                        b.weights * b.active_mask, dtype=dtype
+                    features=put_entities(
+                        jnp.asarray(pad_e(b.features), dtype=dtype)
                     ),
-                    sample_pos=jnp.asarray(b.sample_pos),
+                    labels=put_entities(
+                        jnp.asarray(pad_e(b.labels), dtype=dtype)
+                    ),
+                    offsets=put_entities(
+                        jnp.asarray(pad_e(b.offsets), dtype=dtype)
+                    ),
+                    weights=put_entities(
+                        jnp.asarray(pad_e(b.weights), dtype=dtype)
+                    ),
+                    train_weights=put_entities(
+                        jnp.asarray(
+                            pad_e(b.weights * b.active_mask), dtype=dtype
+                        )
+                    ),
+                    sample_pos=put_entities(
+                        jnp.asarray(pad_e(b.sample_pos, fill=dataset.num_samples))
+                    ),
                     entity_ids=b.entity_ids,
                     col_index=b.col_index,
                 )
@@ -305,12 +367,13 @@ class RandomEffectCoordinate(Coordinate):
                         db.features, db.labels, db.offsets, db.train_weights, coefs
                     )
                 )
+            e_real = len(host_bucket.entity_ids)  # drop mesh-padding lanes
             buckets.append(
                 BucketCoefficients(
                     entity_ids=host_bucket.entity_ids,
                     col_index=host_bucket.col_index,
-                    coefficients=np.asarray(coefs),
-                    variances=variances,
+                    coefficients=np.asarray(coefs)[:e_real],
+                    variances=None if variances is None else variances[:e_real],
                 )
             )
         return RandomEffectModel(
@@ -331,12 +394,17 @@ def build_coordinate(
     normalization: NormalizationContext = NormalizationContext(),
     re_dataset: RandomEffectDataset | None = None,
     dtype=jnp.float32,
+    mesh=None,
 ) -> Coordinate:
     """Config → coordinate dispatch (reference CoordinateFactory.build)."""
     if isinstance(config, FixedEffectCoordinateConfig):
-        return FixedEffectCoordinate.build(data, config, normalization, dtype)
+        return FixedEffectCoordinate.build(
+            data, config, normalization, dtype, mesh=mesh
+        )
     if isinstance(config, RandomEffectCoordinateConfig):
         if re_dataset is None:
             raise ValueError("random-effect coordinate needs a built dataset")
-        return RandomEffectCoordinate.build(data, re_dataset, config, dtype)
+        return RandomEffectCoordinate.build(
+            data, re_dataset, config, dtype, mesh=mesh
+        )
     raise TypeError(f"unknown coordinate config {type(config)}")

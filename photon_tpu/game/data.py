@@ -31,6 +31,10 @@ import numpy as np
 from photon_tpu.game.config import ProjectorType, RandomEffectCoordinateConfig
 from photon_tpu.ops.losses import POSITIVE_RESPONSE_THRESHOLD
 
+#: Entity key for mesh-padding rows: such rows carry weight 0 and belong to
+#: no random-effect entity (they are skipped when grouping by entity).
+PAD_ENTITY_KEY = "__photon_pad__"
+
 
 @dataclasses.dataclass
 class CSRMatrix:
@@ -128,10 +132,56 @@ class GameData:
             weights=np.ones(n) if weights is None else np.asarray(weights),
             feature_shards=dict(feature_shards),
             id_tags={
-                t: np.asarray(v) for t, v in (id_tags or {}).items()
+                t: np.asarray(v).astype(str)
+                for t, v in (id_tags or {}).items()
             },
             uids=uids,
         )
+
+
+def pad_game_data(data: GameData, multiple: int) -> GameData:
+    """Round the sample count up to ``multiple`` with zero-weight rows.
+
+    Mesh sharding needs every device-sharded dimension evenly divisible, so
+    the estimator pads once at ingest; padding rows have weight 0 (invisible
+    to every weighted reduction), empty feature rows, and the PAD_ENTITY_KEY
+    id tag (excluded from random-effect grouping).
+    """
+    from photon_tpu.parallel.mesh import pad_rows_to_multiple
+
+    n = data.num_samples
+    target = pad_rows_to_multiple(n, multiple)
+    if target == n:
+        return data
+    pad = target - n
+    shards = {}
+    for name, m in data.feature_shards.items():
+        indptr = np.concatenate(
+            [m.indptr, np.full(pad, m.indptr[-1], dtype=m.indptr.dtype)]
+        )
+        shards[name] = CSRMatrix(
+            indptr=indptr,
+            indices=m.indices,
+            values=m.values,
+            num_cols=m.num_cols,
+        )
+    id_tags = {
+        tag: np.concatenate(
+            [np.asarray(col).astype(str), np.full(pad, PAD_ENTITY_KEY)]
+        )
+        for tag, col in data.id_tags.items()
+    }
+    uids = None
+    if data.uids is not None:
+        uids = list(data.uids) + [None] * pad
+    return GameData(
+        labels=np.concatenate([data.labels, np.zeros(pad)]),
+        offsets=np.concatenate([data.offsets, np.zeros(pad)]),
+        weights=np.concatenate([data.weights, np.zeros(pad)]),
+        feature_shards=shards,
+        id_tags=id_tags,
+        uids=uids,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -254,15 +304,17 @@ def build_random_effect_dataset(
     """
     rng = np.random.default_rng(seed)
     shard = data.feature_shards[config.feature_shard]
-    keys = data.id_tags[config.random_effect_type]
+    keys = np.asarray(data.id_tags[config.random_effect_type])
     n = data.num_samples
 
-    # entity vocabulary and per-sample dense entity index
-    vocab, entity_of_sample = np.unique(keys, return_inverse=True)
-    counts = np.bincount(entity_of_sample, minlength=len(vocab))
+    # entity vocabulary and per-sample dense entity index; mesh-padding
+    # rows (PAD_ENTITY_KEY) belong to no entity and are skipped
+    valid_idx = np.flatnonzero(keys != PAD_ENTITY_KEY)
+    vocab, entity_of_valid = np.unique(keys[valid_idx], return_inverse=True)
+    counts = np.bincount(entity_of_valid, minlength=len(vocab))
 
     # sort sample indices by entity for contiguous grouping
-    order = np.argsort(entity_of_sample, kind="stable")
+    order = valid_idx[np.argsort(entity_of_valid, kind="stable")]
     group_starts = np.zeros(len(vocab) + 1, dtype=np.int64)
     np.cumsum(counts, out=group_starts[1:])
 

@@ -68,6 +68,11 @@ class GameEstimator:
     normalization_contexts: Mapping[str, NormalizationContext] | None = None
     locked_coordinates: frozenset = frozenset()
     validation_evaluator: EvaluatorType | None = None
+    #: (data, entity) device mesh; when set, fixed-effect batches shard
+    #: rows over the whole mesh (gradient psums over ICI) and random-effect
+    #: buckets shard entities over the entity axis — the reference's
+    #: treeAggregate + entity partitioner, SURVEY §2.10/§5.8.
+    mesh: object | None = None
     dtype: object = jnp.float32
     seed: int = 0
 
@@ -94,11 +99,14 @@ class GameEstimator:
                     norm.get(cfg.feature_shard, NormalizationContext()),
                     self.dtype,
                     seed=self.seed,
+                    mesh=self.mesh,
                 )
             elif isinstance(cfg, RandomEffectCoordinateConfig):
                 ds = build_random_effect_dataset(data, cfg, seed=self.seed)
                 re_datasets[cid] = ds
-                coords[cid] = RandomEffectCoordinate.build(data, ds, cfg, self.dtype)
+                coords[cid] = RandomEffectCoordinate.build(
+                    data, ds, cfg, self.dtype, mesh=self.mesh
+                )
                 logger.info(
                     "coordinate %s: %d entities in %d buckets "
                     "(padded shapes %s)",
@@ -128,6 +136,10 @@ class GameEstimator:
     ) -> list[GameTrainingResult]:
         """Train one GameModel per λ-grid point, warm-starting across the
         grid (reference fit :304-390 + train :746)."""
+        if self.mesh is not None:
+            from photon_tpu.game.data import pad_game_data
+
+            data = pad_game_data(data, int(self.mesh.devices.size))
         coordinates, re_datasets = self._build_coordinates(data)
 
         init_states = None

@@ -56,10 +56,12 @@ def make_mesh(
 
 
 def shard_batch(batch: LabeledBatch, mesh: Mesh) -> LabeledBatch:
-    """Place a batch with rows sharded over the data axis (features'
-    feature-dimension replicated)."""
-    row_sharded = NamedSharding(mesh, P(BATCH_AXIS))
-    mat_sharded = NamedSharding(mesh, P(BATCH_AXIS, None))
+    """Place a batch with rows sharded over every mesh device (features'
+    feature-dimension replicated). Rows spread over both axes so a
+    fixed-effect solve uses the whole mesh, not just the data axis."""
+    axes = tuple(mesh.axis_names)
+    row_sharded = NamedSharding(mesh, P(axes))
+    mat_sharded = NamedSharding(mesh, P(axes, None))
     return LabeledBatch(
         features=jax.device_put(batch.features, mat_sharded),
         labels=jax.device_put(batch.labels, row_sharded),
