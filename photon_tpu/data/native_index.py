@@ -32,6 +32,9 @@ METADATA_FILE = "_index_metadata.json"
 _REPO_ROOT = Path(__file__).resolve().parents[2]
 _NATIVE_DIR = _REPO_ROOT / "native"
 _LIB_PATH = _NATIVE_DIR / "build" / "libphoton_native.so"
+#: wheel-installed copy (built by setup.py); takes precedence over the
+#: make-on-demand source build, and PHOTON_NATIVE_LIB overrides both
+_PACKAGED_LIB = Path(__file__).resolve().parent / "_native" / "libphoton_native.so"
 
 
 # ---------------------------------------------------------------------------
@@ -96,16 +99,27 @@ def _load_native_lib():
     if _lib is not None or _lib_unavailable:
         return _lib
     try:
-        # Always invoke make: it is a no-op when the .so is current, and it
-        # rebuilds after feature_index.cpp changes instead of silently using
-        # a stale library. The Makefile links to a temp file and atomically
-        # renames, so concurrent first-use builds can't load a torn .so.
-        subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR)],
-            check=True,
-            capture_output=True,
-        )
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        override = os.environ.get("PHOTON_NATIVE_LIB")
+        if override:
+            lib_path = Path(override)
+        elif _NATIVE_DIR.exists():
+            # Source checkout: invoke make — a no-op when the .so is
+            # current, and it rebuilds after feature_index.cpp changes
+            # instead of silently using a stale library (which is why the
+            # source build outranks a packaged .so lingering from an old
+            # `pip install .`). The Makefile links to a temp file and
+            # atomically renames, so concurrent first-use builds can't
+            # load a torn .so.
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+            )
+            lib_path = _LIB_PATH
+        else:
+            # Wheel install: the copy setup.py built into the package.
+            lib_path = _PACKAGED_LIB
+        lib = ctypes.CDLL(str(lib_path))
         lib.fix_open.restype = ctypes.c_void_p
         lib.fix_open.argtypes = [ctypes.c_char_p]
         lib.fix_close.argtypes = [ctypes.c_void_p]

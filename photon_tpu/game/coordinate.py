@@ -96,11 +96,13 @@ class FixedEffectCoordinate(Coordinate):
                 weights[neg & keep_draw] /= rate
             else:
                 weights[~keep_draw] = 0.0
+        # numpy handles bfloat16 via ml_dtypes, so one host-side conversion
+        # covers every supported dtype
         batch = LabeledBatch(
-            features=shard.to_dense(),
-            labels=data.labels,
-            offsets=data.offsets,
-            weights=weights,
+            features=shard.to_dense(dtype=dtype),
+            labels=np.asarray(data.labels, dtype=dtype),
+            offsets=np.asarray(data.offsets, dtype=dtype),
+            weights=np.asarray(weights, dtype=dtype),
         )
         if mesh is not None:
             from photon_tpu.parallel.mesh import shard_batch
@@ -109,18 +111,10 @@ class FixedEffectCoordinate(Coordinate):
             # psum over ICI (the reference's treeAggregate, SURVEY §5.8).
             # device_put straight from host numpy so no single device ever
             # holds the whole [N, D] block.
-            batch = shard_batch(batch._replace(
-                features=np.asarray(batch.features, dtype=dtype),
-                labels=np.asarray(batch.labels, dtype=dtype),
-                offsets=np.asarray(batch.offsets, dtype=dtype),
-                weights=np.asarray(batch.weights, dtype=dtype),
-            ), mesh)
+            batch = shard_batch(batch, mesh)
         else:
-            batch = LabeledBatch(
-                features=jnp.asarray(batch.features, dtype=dtype),
-                labels=jnp.asarray(batch.labels, dtype=dtype),
-                offsets=jnp.asarray(batch.offsets, dtype=dtype),
-                weights=jnp.asarray(batch.weights, dtype=dtype),
+            batch = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, dtype=dtype), batch
             )
         problem = GLMProblem.build(
             config.optimization.with_regularization_weight(

@@ -1,0 +1,48 @@
+"""Build hooks for photon-tpu.
+
+Compiles the native runtime (native/feature_index.cpp — the mmap feature
+index store reader, the TPU build's PalDB equivalent, SURVEY.md §2.9) into
+``photon_tpu/data/_native/libphoton_native.so`` so installed wheels carry
+the shared library. Source checkouts don't need this: the loader falls back
+to building ``native/`` with make on first use.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = Path(__file__).resolve().parent
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        dest = ROOT / "photon_tpu" / "data" / "_native"
+        dest.mkdir(parents=True, exist_ok=True)
+        out = dest / "libphoton_native.so"
+        src = ROOT / "native" / "feature_index.cpp"
+        cmd = [
+            "g++",
+            "-O2",
+            "-std=c++17",
+            "-fPIC",
+            "-Wall",
+            "-shared",
+            "-o",
+            str(out),
+            str(src),
+        ]
+        try:
+            subprocess.run(cmd, check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            # Pure-Python fallback exists; warn instead of failing install.
+            print(
+                f"warning: native feature-index build failed ({e}); "
+                "the pure-Python store reader will be used",
+                file=sys.stderr,
+            )
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})
