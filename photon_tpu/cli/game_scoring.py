@@ -63,13 +63,7 @@ def run(argv=None) -> dict:
             for cid, cm in model.coordinates.items():
                 log.info("coordinate %s: %s", cid, type(cm).__name__)
 
-        id_tags = sorted(
-            {
-                cm.random_effect_type
-                for cm in model.coordinates.values()
-                if hasattr(cm, "random_effect_type")
-            }
-        )
+        id_tags = sorted(model.required_id_tags())
         with Timed("read scoring data"):
             paths = game_base.resolve_input_paths(args)
             data, _ = game_base.read_game_data(

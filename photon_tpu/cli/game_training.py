@@ -220,13 +220,9 @@ def run(argv=None) -> dict:
         raise ValueError(
             "--partial-retrain-locked-coordinates requires --model-input-directory"
         )
-    id_tags = sorted(
-        {
-            c.random_effect_type
-            for c in coordinate_configs.values()
-            if c.is_random_effect
-        }
-    )
+    from photon_tpu.game.config import required_id_tags
+
+    id_tags = sorted(required_id_tags(coordinate_configs.values()))
     evaluators = game_base.evaluators_from_args(args)
     validation_evaluator = evaluators[0] if evaluators else None
 

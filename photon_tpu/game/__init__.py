@@ -1,5 +1,6 @@
 from photon_tpu.game.config import (  # noqa: F401
     FixedEffectCoordinateConfig,
+    MatrixFactorizationCoordinateConfig,
     RandomEffectCoordinateConfig,
 )
 from photon_tpu.game.data import CSRMatrix, GameData  # noqa: F401
@@ -7,6 +8,7 @@ from photon_tpu.game.estimator import GameEstimator  # noqa: F401
 from photon_tpu.game.model import (  # noqa: F401
     FixedEffectModel,
     GameModel,
+    MatrixFactorizationModel,
     RandomEffectModel,
 )
 from photon_tpu.game.transformer import GameTransformer  # noqa: F401

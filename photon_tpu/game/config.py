@@ -17,6 +17,7 @@ import enum
 from typing import Sequence
 
 from photon_tpu.optimize.problem import GLMProblemConfig
+from photon_tpu.types import OptimizerType
 
 
 class ProjectorType(enum.Enum):
@@ -71,4 +72,70 @@ class RandomEffectCoordinateConfig:
         return True
 
 
-CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
+@dataclasses.dataclass(frozen=True)
+class MatrixFactorizationCoordinateConfig:
+    """One matrix-factorization coordinate: score = ⟨u_row, v_col⟩ between
+    two entity id tags (e.g. userId × movieId), trained on the coordinate-
+    descent residual like any other GAME coordinate.
+
+    The reference describes MF as a GAME component and ships the
+    LatentFactorAvro schema (README.md:87-89, LatentFactorAvro.avsc) but
+    contains no implementation (SURVEY.md §2.8) — this realizes it: the
+    factor tables live as dense [num_entities, k] device arrays and the
+    solve is one jit-compiled L-BFGS over both tables jointly, with the
+    task's pointwise loss applied to margin = offset + residual + ⟨u, v⟩.
+    """
+
+    row_entity_type: str  # id-tag column for rows (e.g. "userId")
+    col_entity_type: str  # id-tag column for columns (e.g. "movieId")
+    optimization: GLMProblemConfig
+    num_factors: int = 16
+    #: L2 strength on both factor tables (λ/2·(‖U‖² + ‖V‖²)); MF always
+    #: regularizes with L2 regardless of the GLM regularization context
+    regularization_weights: Sequence[float] = (1.0,)
+    #: factor-init scale; factors start at N(0, scale/sqrt(k)) to break the
+    #: ⟨u,v⟩ saddle at zero
+    init_scale: float = 0.1
+
+    def __post_init__(self):
+        # The MF solve is a joint L-BFGS with an L2 penalty; reject settings
+        # it would otherwise silently ignore.
+        opt = self.optimization
+        if opt.optimizer not in (OptimizerType.LBFGS,):
+            raise ValueError(
+                "matrix factorization trains with LBFGS only "
+                f"(got {opt.optimizer})"
+            )
+        if opt.regularization.l1_weight(1.0) > 0:
+            raise ValueError(
+                "matrix factorization supports only L2 regularization"
+            )
+        if opt.down_sampling_rate != 1.0:
+            raise ValueError(
+                "matrix factorization does not support down-sampling"
+            )
+        if self.num_factors < 1:
+            raise ValueError("num_factors must be >= 1")
+
+    @property
+    def is_random_effect(self) -> bool:
+        return False
+
+
+CoordinateConfig = (
+    FixedEffectCoordinateConfig
+    | RandomEffectCoordinateConfig
+    | MatrixFactorizationCoordinateConfig
+)
+
+
+def required_id_tags(configs) -> set[str]:
+    """Entity id-tag columns the coordinates need from training data."""
+    tags: set[str] = set()
+    for c in configs:
+        if isinstance(c, RandomEffectCoordinateConfig):
+            tags.add(c.random_effect_type)
+        elif isinstance(c, MatrixFactorizationCoordinateConfig):
+            tags.add(c.row_entity_type)
+            tags.add(c.col_entity_type)
+    return tags

@@ -27,12 +27,14 @@ import numpy as np
 
 from photon_tpu.game.config import (
     FixedEffectCoordinateConfig,
+    MatrixFactorizationCoordinateConfig,
     RandomEffectCoordinateConfig,
 )
 from photon_tpu.game.data import GameData, RandomEffectDataset
 from photon_tpu.game.model import (
     BucketCoefficients,
     FixedEffectModel,
+    MatrixFactorizationModel,
     RandomEffectModel,
 )
 from photon_tpu.models.coefficients import Coefficients
@@ -381,6 +383,147 @@ class RandomEffectCoordinate(Coordinate):
         )
 
 
+@dataclasses.dataclass(eq=False)
+class MatrixFactorizationCoordinate(Coordinate):
+    """Latent-factor coordinate: score = ⟨u_row, v_col⟩ (config docstring
+    for design; MatrixFactorizationCoordinateConfig).
+
+    State is the pair of dense factor tables ``(U [R,k], V [C,k])``; one
+    training step is a jit-compiled joint L-BFGS over both tables with the
+    task's pointwise loss on margin = offset + residual + ⟨u, v⟩ and
+    λ/2·(‖U‖² + ‖V‖²) regularization. Gather/scatter of per-sample factor
+    rows is XLA's autodiff of the table indexing — no joins, no hogwild.
+    """
+
+    config: object
+    row_vocab: np.ndarray
+    col_vocab: np.ndarray
+    row_idx: Array  # [N] int32
+    col_idx: Array  # [N] int32
+    labels: Array
+    offsets: Array
+    weights: Array
+    l2_weight: float
+    dtype: object
+    seed: int
+
+    @staticmethod
+    def build(
+        data: GameData,
+        config,
+        dtype=jnp.float32,
+        mesh=None,
+        seed: int = 0,
+    ):
+        from photon_tpu.game.data import PAD_ENTITY_KEY, entity_row_indices
+
+        r_keys = np.asarray(data.id_tags[config.row_entity_type])
+        c_keys = np.asarray(data.id_tags[config.col_entity_type])
+        row_vocab = np.unique(r_keys[r_keys != PAD_ENTITY_KEY])
+        col_vocab = np.unique(c_keys[c_keys != PAD_ENTITY_KEY])
+        r_index = {k: i for i, k in enumerate(row_vocab)}
+        c_index = {k: i for i, k in enumerate(col_vocab)}
+        # padding rows point at factor row 0 but carry weight 0
+        row_idx = entity_row_indices(r_index, r_keys, 0).astype(np.int32)
+        col_idx = entity_row_indices(c_index, c_keys, 0).astype(np.int32)
+        arrays = dict(
+            row_idx=row_idx,
+            col_idx=col_idx,
+            labels=np.asarray(data.labels, dtype=dtype),
+            offsets=np.asarray(data.offsets, dtype=dtype),
+            weights=np.asarray(data.weights, dtype=dtype),
+        )
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rows = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+            arrays = {
+                k: jax.device_put(v, rows) for k, v in arrays.items()
+            }
+        else:
+            arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
+        return MatrixFactorizationCoordinate(
+            config=config,
+            row_vocab=row_vocab,
+            col_vocab=col_vocab,
+            l2_weight=float(config.regularization_weights[0]),
+            dtype=dtype,
+            seed=seed,
+            **arrays,
+        )
+
+    def with_regularization_weight(self, w: float):
+        return dataclasses.replace(self, l2_weight=float(w))
+
+    def initial_state(self) -> tuple[Array, Array]:
+        k = self.config.num_factors
+        rng = np.random.default_rng(self.seed)
+        scale = self.config.init_scale / np.sqrt(k)
+        u = rng.normal(scale=scale, size=(len(self.row_vocab), k))
+        v = rng.normal(scale=scale, size=(len(self.col_vocab), k))
+        return (
+            jnp.asarray(u, dtype=self.dtype),
+            jnp.asarray(v, dtype=self.dtype),
+        )
+
+    @partial(jax.jit, static_argnums=0)
+    def _train_jit(self, residual_scores: Array, u0: Array, v0: Array):
+        from photon_tpu.ops.losses import loss_for_task
+        from photon_tpu.optimize.lbfgs import minimize_lbfgs
+
+        loss = loss_for_task(self.config.optimization.task)
+        shapes = (u0.shape, v0.shape)
+        sizes = (u0.size, v0.size)
+
+        def unpack(x):
+            u = x[: sizes[0]].reshape(shapes[0])
+            v = x[sizes[0] :].reshape(shapes[1])
+            return u, v
+
+        offsets = self.offsets + residual_scores
+
+        def value_and_grad(x):
+            def value(x):
+                u, v = unpack(x)
+                margin = offsets + jnp.einsum(
+                    "nk,nk->n", u[self.row_idx], v[self.col_idx]
+                )
+                data_term = jnp.sum(
+                    self.weights * loss.loss(margin, self.labels)
+                )
+                reg = 0.5 * self.l2_weight * jnp.sum(x * x)
+                return data_term + reg
+
+            return jax.value_and_grad(value)(x)
+
+        x0 = jnp.concatenate([u0.ravel(), v0.ravel()])
+        res = minimize_lbfgs(
+            value_and_grad, x0, self.config.optimization.optimizer_config
+        )
+        u, v = unpack(res.x)
+        return u, v, res
+
+    def train(self, residual_scores: Array, state):
+        u, v, res = self._train_jit(residual_scores, state[0], state[1])
+        return (u, v), res
+
+    @partial(jax.jit, static_argnums=0)
+    def score(self, state) -> Array:
+        u, v = state
+        s = jnp.einsum("nk,nk->n", u[self.row_idx], v[self.col_idx])
+        return jnp.where(self.weights > 0, s, 0.0)
+
+    def to_model(self, state) -> MatrixFactorizationModel:
+        return MatrixFactorizationModel(
+            row_entity_type=self.config.row_entity_type,
+            col_entity_type=self.config.col_entity_type,
+            row_vocab=self.row_vocab,
+            col_vocab=self.col_vocab,
+            row_factors=np.asarray(state[0], dtype=np.float64),
+            col_factors=np.asarray(state[1], dtype=np.float64),
+        )
+
+
 def build_coordinate(
     data: GameData,
     config,
@@ -389,6 +532,7 @@ def build_coordinate(
     re_dataset: RandomEffectDataset | None = None,
     dtype=jnp.float32,
     mesh=None,
+    seed: int = 0,
 ) -> Coordinate:
     """Config → coordinate dispatch (reference CoordinateFactory.build)."""
     if isinstance(config, FixedEffectCoordinateConfig):
@@ -400,5 +544,9 @@ def build_coordinate(
             raise ValueError("random-effect coordinate needs a built dataset")
         return RandomEffectCoordinate.build(
             data, re_dataset, config, dtype, mesh=mesh
+        )
+    if isinstance(config, MatrixFactorizationCoordinateConfig):
+        return MatrixFactorizationCoordinate.build(
+            data, config, dtype, mesh=mesh, seed=seed
         )
     raise TypeError(f"unknown coordinate config {type(config)}")

@@ -139,6 +139,15 @@ class GameData:
         )
 
 
+def entity_row_indices(index, keys, oov: int) -> np.ndarray:
+    """Map entity keys to dense table rows, ``oov`` for unseen keys — the
+    scoring-time entity lookup shared by random-effect and MF models."""
+    keys = np.asarray(keys)
+    return np.fromiter(
+        (index.get(k, oov) for k in keys), dtype=np.int64, count=len(keys)
+    )
+
+
 def pad_game_data(data: GameData, multiple: int) -> GameData:
     """Round the sample count up to ``multiple`` with zero-weight rows.
 

@@ -26,10 +26,12 @@ from photon_tpu.evaluation.evaluators import EvaluatorType
 from photon_tpu.game.config import (
     CoordinateConfig,
     FixedEffectCoordinateConfig,
+    MatrixFactorizationCoordinateConfig,
     RandomEffectCoordinateConfig,
 )
 from photon_tpu.game.coordinate import (
     FixedEffectCoordinate,
+    MatrixFactorizationCoordinate,
     RandomEffectCoordinate,
 )
 from photon_tpu.game.data import GameData, build_random_effect_dataset
@@ -114,6 +116,10 @@ class GameEstimator:
                     ds.num_entities,
                     len(ds.buckets),
                     [(b.features.shape) for b in ds.buckets],
+                )
+            elif isinstance(cfg, MatrixFactorizationCoordinateConfig):
+                coords[cid] = MatrixFactorizationCoordinate.build(
+                    data, cfg, self.dtype, mesh=self.mesh, seed=self.seed
                 )
             else:
                 raise TypeError(f"unknown coordinate config for {cid}")
@@ -253,4 +259,22 @@ class GameEstimator:
                         w0[i][valid] = vec[cols[valid]]
                     bucket_states.append(jnp.asarray(w0, dtype=self.dtype))
                 states[cid] = bucket_states
+            elif isinstance(coord, MatrixFactorizationCoordinate):
+                u0, v0 = coord.initial_state()
+                u0, v0 = np.array(u0), np.array(v0)  # writable copies
+                r_prior = {k: i for i, k in enumerate(prior.row_vocab)}
+                c_prior = {k: i for i, k in enumerate(prior.col_vocab)}
+                k_common = min(u0.shape[1], prior.row_factors.shape[1])
+                for i, key in enumerate(coord.row_vocab):
+                    pi = r_prior.get(key)
+                    if pi is not None:
+                        u0[i, :k_common] = prior.row_factors[pi, :k_common]
+                for i, key in enumerate(coord.col_vocab):
+                    pi = c_prior.get(key)
+                    if pi is not None:
+                        v0[i, :k_common] = prior.col_factors[pi, :k_common]
+                states[cid] = (
+                    jnp.asarray(u0, dtype=self.dtype),
+                    jnp.asarray(v0, dtype=self.dtype),
+                )
         return states

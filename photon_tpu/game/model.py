@@ -122,15 +122,13 @@ class RandomEffectModel:
         row-aligned sparse product instead of a per-sample loop."""
         from scipy import sparse
 
+        from photon_tpu.game.data import entity_row_indices
+
         shard = data.feature_shards[self.feature_shard]
         keys = data.id_tags[self.random_effect_type]
         coef_csr, index = self._entity_coefficient_csr()
         zero_row = len(self.vocab)
-        entity_per_row = np.fromiter(
-            (index.get(k, zero_row) for k in keys),
-            dtype=np.int64,
-            count=len(keys),
-        )
+        entity_per_row = entity_row_indices(index, keys, zero_row)
         x = sparse.csr_matrix(
             (shard.values, shard.indices, shard.indptr),
             shape=(shard.num_rows, shard.num_cols),
@@ -174,11 +172,55 @@ class RandomEffectModel:
 
 
 @dataclasses.dataclass(frozen=True)
+class MatrixFactorizationModel:
+    """Latent factor tables for a row × col entity interaction.
+
+    The reference's MF-as-GAME-component design (README.md:87-89 +
+    LatentFactorAvro.avsc; unimplemented there, SURVEY.md §2.8): score for a
+    sample is ⟨u_row, v_col⟩; entities unseen at training time contribute 0
+    (the MF analogue of random-effect cold scoring).
+    """
+
+    row_entity_type: str
+    col_entity_type: str
+    row_vocab: np.ndarray  # [R] entity keys
+    col_vocab: np.ndarray  # [C] entity keys
+    row_factors: np.ndarray  # [R, k]
+    col_factors: np.ndarray  # [C, k]
+
+    @property
+    def num_factors(self) -> int:
+        return self.row_factors.shape[1]
+
+    def score_cold(self, data: GameData) -> np.ndarray:
+        row_index = {k: i for i, k in enumerate(self.row_vocab)}
+        col_index = {k: i for i, k in enumerate(self.col_vocab)}
+        # zero row at the end for unseen entities
+        u = np.concatenate(
+            [self.row_factors, np.zeros((1, self.num_factors))]
+        )
+        v = np.concatenate(
+            [self.col_factors, np.zeros((1, self.num_factors))]
+        )
+        from photon_tpu.game.data import entity_row_indices
+
+        ri = entity_row_indices(
+            row_index, data.id_tags[self.row_entity_type], len(row_index)
+        )
+        ci = entity_row_indices(
+            col_index, data.id_tags[self.col_entity_type], len(col_index)
+        )
+        return np.einsum("nk,nk->n", u[ri], v[ci])
+
+
+@dataclasses.dataclass(frozen=True)
 class GameModel:
     """coordinate id → model, scored additively (reference GameModel.scala:32;
     score composition mirrors GameTransformer.scoreGameDataSet:269)."""
 
-    coordinates: Mapping[str, FixedEffectModel | RandomEffectModel]
+    coordinates: Mapping[
+        str, FixedEffectModel | RandomEffectModel | MatrixFactorizationModel
+    ]
     task: TaskType
 
     def score(
@@ -204,6 +246,17 @@ class GameModel:
             self.task, Coefficients(means=jnp.zeros((1,)))
         )
         return np.asarray(glm.compute_mean(jnp.asarray(margins)))
+
+    def required_id_tags(self) -> set[str]:
+        """Entity id-tag columns the model needs from scoring data."""
+        tags: set[str] = set()
+        for cm in self.coordinates.values():
+            if isinstance(cm, RandomEffectModel):
+                tags.add(cm.random_effect_type)
+            elif isinstance(cm, MatrixFactorizationModel):
+                tags.add(cm.row_entity_type)
+                tags.add(cm.col_entity_type)
+        return tags
 
     def __getitem__(self, cid: str):
         return self.coordinates[cid]

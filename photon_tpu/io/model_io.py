@@ -26,6 +26,7 @@ from photon_tpu.game.model import (
     BucketCoefficients,
     FixedEffectModel,
     GameModel,
+    MatrixFactorizationModel,
     RandomEffectModel,
 )
 from photon_tpu.io import schemas
@@ -37,6 +38,9 @@ from photon_tpu.types import TaskType
 SPARSITY_THRESHOLD = 1e-4
 FIXED_EFFECT = "fixed-effect"
 RANDOM_EFFECT = "random-effect"
+MATRIX_FACTORIZATION = "matrix-factorization"
+ROW_FACTORS = "row-latent-factors"
+COL_FACTORS = "col-latent-factors"
 ID_INFO = "id-info"
 COEFFICIENTS = "coefficients"
 DEFAULT_AVRO_FILE = "part-00000.avro"
@@ -236,6 +240,32 @@ def save_game_model(
                     chunk,
                 )
                 part += 1
+        elif isinstance(coord_model, MatrixFactorizationModel):
+            d = out / MATRIX_FACTORIZATION / cid
+            d.mkdir(parents=True, exist_ok=True)
+            (d / ID_INFO).write_text(
+                coord_model.row_entity_type
+                + "\n"
+                + coord_model.col_entity_type
+                + "\n"
+            )
+            for sub, vocab, factors in (
+                (ROW_FACTORS, coord_model.row_vocab, coord_model.row_factors),
+                (COL_FACTORS, coord_model.col_vocab, coord_model.col_factors),
+            ):
+                (d / sub).mkdir(parents=True, exist_ok=True)
+                records = [
+                    {
+                        "effectId": str(key),
+                        "latentFactor": [float(x) for x in factors[i]],
+                    }
+                    for i, key in enumerate(vocab)
+                ]
+                write_avro_file(
+                    d / sub / DEFAULT_AVRO_FILE,
+                    schemas.LATENT_FACTOR_AVRO,
+                    records,
+                )
         else:
             raise TypeError(f"unknown coordinate model for {cid}")
 
@@ -335,6 +365,31 @@ def load_game_model(
             records = list(read_avro_dir(cdir / COEFFICIENTS))
             coordinates[cdir.name] = _records_to_random_effect_model(
                 records, re_type, shard, task, imap, proj
+            )
+
+    mf_dir = out / MATRIX_FACTORIZATION
+    if mf_dir.is_dir():
+        for cdir in sorted(mf_dir.iterdir()):
+            if not cdir.is_dir():
+                continue
+            lines = (cdir / ID_INFO).read_text().strip().splitlines()
+            row_type, col_type = lines[0], lines[1]
+            tables = {}
+            for sub in (ROW_FACTORS, COL_FACTORS):
+                records = list(read_avro_dir(cdir / sub))
+                records.sort(key=lambda r: str(r["effectId"]))
+                vocab = np.array([str(r["effectId"]) for r in records])
+                factors = np.array(
+                    [list(map(float, r["latentFactor"])) for r in records]
+                )
+                tables[sub] = (vocab, factors)
+            coordinates[cdir.name] = MatrixFactorizationModel(
+                row_entity_type=row_type,
+                col_entity_type=col_type,
+                row_vocab=tables[ROW_FACTORS][0],
+                col_vocab=tables[COL_FACTORS][0],
+                row_factors=tables[ROW_FACTORS][1],
+                col_factors=tables[COL_FACTORS][1],
             )
 
     return GameModel(coordinates=coordinates, task=task)

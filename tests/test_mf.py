@@ -1,0 +1,215 @@
+"""Matrix-factorization coordinate tests — the GAME component the reference
+describes (README.md:87-89, LatentFactorAvro.avsc) but never implemented
+(SURVEY.md §2.8): factor recovery, composition with fixed effects through
+coordinate descent, model save/load with LatentFactorAvro records, cold
+scoring, and mesh parity.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.index_map import DefaultIndexMap, feature_key
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    MatrixFactorizationCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator
+from photon_tpu.io.model_io import load_game_model, save_game_model
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import GLMProblemConfig
+from photon_tpu.parallel import make_mesh
+from photon_tpu.types import TaskType
+
+K_TRUE = 3
+
+
+def _mf_data(seed=0, n=3000, users=40, items=30, d_fixed=5, noise=0.05):
+    rng = np.random.default_rng(seed)
+    u_true = rng.normal(size=(users, K_TRUE)) / np.sqrt(K_TRUE)
+    v_true = rng.normal(size=(items, K_TRUE)) / np.sqrt(K_TRUE)
+    uid = rng.integers(0, users, size=n)
+    iid = rng.integers(0, items, size=n)
+    x = rng.normal(size=(n, d_fixed))
+    w = rng.normal(size=d_fixed)
+    margin = x @ w + np.einsum("nk,nk->n", u_true[uid], v_true[iid])
+    y = margin + rng.normal(scale=noise, size=n)
+    data = GameData.build(
+        labels=y,
+        feature_shards={"global": CSRMatrix.from_dense(x)},
+        id_tags={
+            "userId": [f"u{i}" for i in uid],
+            "itemId": [f"m{i}" for i in iid],
+        },
+    )
+    return data, uid, iid, u_true, v_true
+
+
+def _configs(num_factors=4, mf_l2=0.3):
+    opt = GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=200, tolerance=1e-9),
+    )
+    return {
+        "fixed": FixedEffectCoordinateConfig(
+            feature_shard="global",
+            optimization=opt,
+            regularization_weights=(0.0,),
+        ),
+        "mf": MatrixFactorizationCoordinateConfig(
+            row_entity_type="userId",
+            col_entity_type="itemId",
+            optimization=opt,
+            num_factors=num_factors,
+            regularization_weights=(mf_l2,),
+        ),
+    }
+
+
+def _fit(data, mesh=None, descent_iterations=3, **est_kw):
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=_configs(),
+        update_sequence=["fixed", "mf"],
+        descent_iterations=descent_iterations,
+        mesh=mesh,
+        dtype=jnp.float64,
+        **est_kw,
+    )
+    return est.fit(data)[0].model
+
+
+def test_mf_coordinate_improves_over_fixed_effect():
+    data, *_ = _mf_data()
+    model = _fit(data)
+    scores_full = model.score(data)
+    scores_fe = model["fixed"].score(data)
+    mse_full = float(np.mean((scores_full - data.labels) ** 2))
+    mse_fe = float(np.mean((scores_fe - data.labels) ** 2))
+    # the interaction term is ~half the variance; MF must capture most of it
+    assert mse_full < 0.05
+    assert mse_full < mse_fe / 4
+
+
+def test_mf_save_load_roundtrip(tmp_path):
+    data, *_ = _mf_data(n=800, users=15, items=10)
+    model = _fit(data, descent_iterations=2)
+    imaps = {
+        "global": DefaultIndexMap(
+            {feature_key(f"f{i}"): i for i in range(5)}
+        )
+    }
+    save_game_model(tmp_path / "model", model, imaps)
+
+    assert (
+        tmp_path / "model" / "matrix-factorization" / "mf" /
+        "row-latent-factors" / "part-00000.avro"
+    ).exists()
+
+    loaded = load_game_model(tmp_path / "model", imaps)
+    mf = loaded["mf"]
+    assert mf.row_entity_type == "userId"
+    assert mf.col_entity_type == "itemId"
+    np.testing.assert_allclose(
+        loaded.score(data), model.score(data), atol=1e-9
+    )
+
+
+def test_mf_cold_scoring_unseen_entities_contribute_zero():
+    data, *_ = _mf_data(n=800, users=15, items=10)
+    model = _fit(data, descent_iterations=2)
+    cold = GameData.build(
+        labels=np.zeros(4),
+        feature_shards={"global": CSRMatrix.from_dense(np.zeros((4, 5)))},
+        id_tags={
+            "userId": ["u0", "u-unseen", "u1", "u-unseen"],
+            "itemId": ["m-unseen", "m0", "m1", "m-unseen"],
+        },
+    )
+    s = model["mf"].score_cold(cold)
+    # any pair involving an unseen entity scores exactly 0
+    assert s[0] == 0.0 and s[1] == 0.0 and s[3] == 0.0
+    assert s[2] != 0.0
+
+
+def test_mf_warm_start_from_prior_model():
+    data, *_ = _mf_data(n=800, users=15, items=10)
+    prior = _fit(data, descent_iterations=2)
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs=_configs(),
+        update_sequence=["fixed", "mf"],
+        descent_iterations=1,
+        dtype=jnp.float64,
+    )
+    model = est.fit(data, initial_model=prior)[0].model
+    s_prior = prior.score(data)
+    s_new = model.score(data)
+    mse_prior = float(np.mean((s_prior - data.labels) ** 2))
+    mse_new = float(np.mean((s_new - data.labels) ** 2))
+    assert mse_new <= mse_prior * 1.05  # warm start never regresses much
+
+
+def test_mf_mesh_matches_unsharded():
+    data, *_ = _mf_data(n=501, users=12, items=9)  # non-divisible n
+    model_plain = _fit(data, descent_iterations=2)
+    model_mesh = _fit(
+        data, mesh=make_mesh(num_data=4, num_entity=2), descent_iterations=2
+    )
+    np.testing.assert_allclose(
+        np.asarray(model_mesh["mf"].row_factors),
+        np.asarray(model_plain["mf"].row_factors),
+        atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        model_mesh.score(data), model_plain.score(data), atol=1e-7
+    )
+
+
+def test_mf_required_id_tags_and_config_validation():
+    import pytest
+
+    from photon_tpu.game.config import required_id_tags
+    from photon_tpu.optimize.problem import (
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_tpu.types import OptimizerType
+
+    cfgs = _configs()
+    assert required_id_tags(cfgs.values()) == {"userId", "itemId"}
+
+    data, *_ = _mf_data(n=400, users=8, items=6)
+    model = _fit(data, descent_iterations=1)
+    assert model.required_id_tags() == {"userId", "itemId"}
+
+    opt = GLMProblemConfig(task=TaskType.LINEAR_REGRESSION)
+    with pytest.raises(ValueError, match="LBFGS"):
+        MatrixFactorizationCoordinateConfig(
+            row_entity_type="a",
+            col_entity_type="b",
+            optimization=GLMProblemConfig(
+                task=TaskType.LINEAR_REGRESSION,
+                optimizer=OptimizerType.TRON,
+            ),
+        )
+    with pytest.raises(ValueError, match="L2"):
+        MatrixFactorizationCoordinateConfig(
+            row_entity_type="a",
+            col_entity_type="b",
+            optimization=GLMProblemConfig(
+                task=TaskType.LINEAR_REGRESSION,
+                regularization=RegularizationContext(RegularizationType.L1),
+            ),
+        )
+    with pytest.raises(ValueError, match="down-sampling"):
+        MatrixFactorizationCoordinateConfig(
+            row_entity_type="a",
+            col_entity_type="b",
+            optimization=dataclasses_replace_rate(opt, 0.5),
+        )
+
+
+def dataclasses_replace_rate(cfg, rate):
+    import dataclasses
+
+    return dataclasses.replace(cfg, down_sampling_rate=rate)
