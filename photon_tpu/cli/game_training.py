@@ -207,7 +207,9 @@ def run(argv=None) -> dict:
         c.strip() for c in args.coordinate_update_sequence.split(",") if c.strip()
     ]
     missing_shards = {
-        c.feature_shard for c in coordinate_configs.values()
+        c.feature_shard
+        for c in coordinate_configs.values()
+        if getattr(c, "feature_shard", None) is not None
     } - set(shard_configs)
     if missing_shards:
         raise ValueError(f"coordinates reference unknown shards {missing_shards}")

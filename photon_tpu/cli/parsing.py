@@ -19,6 +19,7 @@ from photon_tpu.evaluation.evaluators import EvaluatorType
 from photon_tpu.game.config import (
     CoordinateConfig,
     FixedEffectCoordinateConfig,
+    MatrixFactorizationCoordinateConfig,
     ProjectorType,
     RandomEffectCoordinateConfig,
 )
@@ -98,9 +99,16 @@ def parse_coordinate_config(
     kv = parse_kv(s)
     try:
         name = kv.pop("name")
-        shard = kv.pop("feature.shard")
     except KeyError as e:
         raise ValueError(f"coordinate config missing {e}") from None
+    is_mf = "row.entity.type" in kv
+    shard = kv.pop("feature.shard", None)
+    if shard is None and not is_mf:
+        raise ValueError("coordinate config missing 'feature.shard'")
+    if shard is not None and is_mf:
+        raise ValueError(
+            "matrix-factorization coordinates take no feature.shard"
+        )
 
     opt_cfg = OptimizerConfig()
     if "max.iter" in kv:
@@ -126,6 +134,27 @@ def parse_coordinate_config(
         ),
         down_sampling_rate=float(kv.pop("down.sampling.rate", "1.0")),
     )
+
+    if is_mf:
+        row_type = kv.pop("row.entity.type")
+        try:
+            col_type = kv.pop("col.entity.type")
+        except KeyError:
+            raise ValueError(
+                "matrix-factorization coordinate needs 'col.entity.type'"
+            ) from None
+        num_factors = int(kv.pop("num.factors", "16"))
+        init_scale = float(kv.pop("init.scale", "0.1"))
+        if kv:
+            raise ValueError(f"unknown coordinate config keys: {sorted(kv)}")
+        return name, MatrixFactorizationCoordinateConfig(
+            row_entity_type=row_type,
+            col_entity_type=col_type,
+            optimization=problem,
+            num_factors=num_factors,
+            regularization_weights=reg_weights,
+            init_scale=init_scale,
+        )
 
     re_type = kv.pop("random.effect.type", None)
     if re_type is None:

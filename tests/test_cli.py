@@ -491,3 +491,102 @@ def test_legacy_driver_avro_input(avro_data, tmp_path):
     imap = driver.index_maps["global"]
     model, _ = load_glm(out / "models" / "lambda-1.0.avro", imap)
     assert model.coefficients.means.shape[0] == len(imap)
+
+
+def test_parse_matrix_factorization_coordinate():
+    from photon_tpu.cli.parsing import parse_coordinate_config
+    from photon_tpu.game.config import MatrixFactorizationCoordinateConfig
+
+    name, cfg = parse_coordinate_config(
+        "name=mf, row.entity.type=userId, col.entity.type=movieId, "
+        "num.factors=8, reg.weights=0.5, max.iter=40, init.scale=0.2",
+        TaskType.LOGISTIC_REGRESSION,
+    )
+    assert name == "mf"
+    assert isinstance(cfg, MatrixFactorizationCoordinateConfig)
+    assert cfg.row_entity_type == "userId"
+    assert cfg.col_entity_type == "movieId"
+    assert cfg.num_factors == 8
+    assert cfg.regularization_weights == [0.5] or tuple(
+        cfg.regularization_weights
+    ) == (0.5,)
+    assert cfg.init_scale == 0.2
+    assert cfg.optimization.optimizer_config.max_iterations == 40
+
+    with pytest.raises(ValueError, match="col.entity.type"):
+        parse_coordinate_config(
+            "name=mf, row.entity.type=userId",
+            TaskType.LOGISTIC_REGRESSION,
+        )
+    with pytest.raises(ValueError, match="no feature.shard"):
+        parse_coordinate_config(
+            "name=mf, row.entity.type=u, col.entity.type=i, feature.shard=g",
+            TaskType.LOGISTIC_REGRESSION,
+        )
+
+
+def test_game_training_and_scoring_with_mf_coordinate(tmp_path):
+    """End-to-end: train FE + MF via the CLI on two-entity interaction data,
+    save, then score through the scoring driver (exercises the id-tag
+    collection path for MF models)."""
+    rng = np.random.default_rng(3)
+    n, users, items = 500, 12, 8
+    u_t = rng.normal(size=(users, 2))
+    v_t = rng.normal(size=(items, 2))
+    records = []
+    for i in range(n):
+        u, m = int(rng.integers(users)), int(rng.integers(items))
+        x = rng.normal(size=3)
+        margin = 0.5 * x.sum() + 1.5 * float(u_t[u] @ v_t[m])
+        y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+        records.append(
+            {
+                "uid": f"s{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(3)
+                ],
+                "metadataMap": {"userId": f"u{u}", "itemId": f"m{m}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+        )
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    write_avro_file(
+        data_dir / "part-00000.avro", TRAINING_EXAMPLE_AVRO, records
+    )
+    out = tmp_path / "training"
+    res = game_training.run(
+        [
+            "--input-data-directories", str(data_dir),
+            "--validation-data-directories", str(data_dir),
+            "--root-output-directory", str(out),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,max.iter=25,"
+            "regularization=L2,reg.weights=1",
+            "--coordinate-configurations",
+            "name=mf,row.entity.type=userId,col.entity.type=itemId,"
+            "num.factors=4,reg.weights=0.5,max.iter=60",
+            "--coordinate-update-sequence", "global,mf",
+            "--coordinate-descent-iterations", "2",
+            "--evaluators", "AUC",
+        ]
+    )
+    assert res["results"][0]["evaluation"] > 0.7
+    assert (out / "best" / "matrix-factorization" / "mf" / "id-info").exists()
+
+    score_out = tmp_path / "scoring"
+    sres = game_scoring.run(
+        [
+            "--input-data-directories", str(data_dir),
+            "--root-output-directory", str(score_out),
+            "--feature-shard-configurations", SHARD_ARG,
+            "--model-input-directory", str(out / "best"),
+            "--evaluators", "AUC",
+        ]
+    )
+    assert sres["evaluations"]["AUC"] > 0.7
