@@ -104,18 +104,27 @@ class GameEstimator:
                     mesh=self.mesh,
                 )
             elif isinstance(cfg, RandomEffectCoordinateConfig):
-                ds = build_random_effect_dataset(data, cfg, seed=self.seed)
+                entity_shards = 1
+                if self.mesh is not None:
+                    from photon_tpu.parallel.mesh import ENTITY_AXIS
+
+                    entity_shards = dict(self.mesh.shape).get(ENTITY_AXIS, 1)
+                ds = build_random_effect_dataset(
+                    data, cfg, seed=self.seed, entity_shards=entity_shards
+                )
                 re_datasets[cid] = ds
                 coords[cid] = RandomEffectCoordinate.build(
                     data, ds, cfg, self.dtype, mesh=self.mesh
                 )
+                waste = ds.padding_waste()
                 logger.info(
                     "coordinate %s: %d entities in %d buckets "
-                    "(padded shapes %s)",
+                    "(padded shapes %s, padding waste %.1f%%)",
                     cid,
                     ds.num_entities,
                     len(ds.buckets),
                     [(b.features.shape) for b in ds.buckets],
+                    100.0 * waste["total_waste"],
                 )
             elif isinstance(cfg, MatrixFactorizationCoordinateConfig):
                 coords[cid] = MatrixFactorizationCoordinate.build(

@@ -78,6 +78,10 @@ class OptimizeResult(NamedTuple):
     reason: Array  # int32 scalar, ConvergenceReason code
     loss_history: Array  # [max_iterations + 1]
     grad_norm_history: Array  # [max_iterations + 1]
+    # Exact work counters (for honest FLOP/MFU accounting in benchmarks):
+    # objective (value+gradient) evaluations and Hessian-vector products.
+    n_evals: Array | int = 0  # int32 scalar
+    n_hvp: Array | int = 0  # int32 scalar
 
     @property
     def converged(self) -> Array:

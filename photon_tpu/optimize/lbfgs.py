@@ -49,6 +49,7 @@ class _LBFGSState(NamedTuple):
     reason: Array
     loss_hist: Array
     gnorm_hist: Array
+    n_evals: Array
 
 
 def two_loop_direction(
@@ -141,6 +142,7 @@ def minimize_lbfgs(
         reason=jnp.zeros((), jnp.int32),
         loss_hist=jnp.full((t + 1,), f0, dtype),
         gnorm_hist=jnp.full((t + 1,), jnp.linalg.norm(g0), dtype),
+        n_evals=jnp.asarray(2, jnp.int32),  # zero-state + initial point
     )
 
     def cond(s: _LBFGSState):
@@ -174,10 +176,12 @@ def minimize_lbfgs(
         )
 
         x_new, f_new, g_new = ls.x, ls.value, ls.gradient
+        n_evals = s.n_evals + ls.num_evals
         if has_box:
             x_proj = project_to_box(x_new, config.lower_bounds, config.upper_bounds)
             f_new, g_new = eval_at(x_proj)
             x_new = x_proj
+            n_evals = n_evals + 1
 
         step_failed = ~ls.success
 
@@ -226,6 +230,7 @@ def minimize_lbfgs(
             reason=reason,
             loss_hist=s.loss_hist.at[it].set(f_new),
             gnorm_hist=s.gnorm_hist.at[it].set(gnorm_new),
+            n_evals=n_evals,
         )
 
     s = lax.while_loop(cond, body, init)
@@ -244,4 +249,6 @@ def minimize_lbfgs(
         reason=s.reason,
         loss_history=loss_hist,
         grad_norm_history=gnorm_hist,
+        n_evals=s.n_evals,
+        n_hvp=jnp.zeros((), jnp.int32),
     )

@@ -50,6 +50,7 @@ class _OWLQNState(NamedTuple):
     reason: Array
     loss_hist: Array
     gnorm_hist: Array
+    n_evals: Array
 
 
 def minimize_owlqn(
@@ -98,6 +99,7 @@ def minimize_owlqn(
         gnorm_hist=jnp.full(
             (t + 1,), jnp.linalg.norm(pseudo_gradient(x0, g0, l1)), dtype
         ),
+        n_evals=jnp.asarray(2, jnp.int32),  # zero-state + initial point
     )
 
     def cond(s: _OWLQNState):
@@ -152,7 +154,7 @@ def minimize_owlqn(
                 ok | accept,
             )
 
-        _, _, _, x_new, f_new, g_new, ls_ok = lax.while_loop(
+        ls_iters, _, _, x_new, f_new, g_new, ls_ok = lax.while_loop(
             ls_cond,
             ls_body,
             (
@@ -209,6 +211,7 @@ def minimize_owlqn(
             reason=reason,
             loss_hist=s.loss_hist.at[it].set(f_new),
             gnorm_hist=s.gnorm_hist.at[it].set(pg_new_norm),
+            n_evals=s.n_evals + ls_iters,
         )
 
     s = lax.while_loop(cond, body, init)
@@ -226,4 +229,6 @@ def minimize_owlqn(
         reason=s.reason,
         loss_history=loss_hist,
         grad_norm_history=gnorm_hist,
+        n_evals=s.n_evals,
+        n_hvp=jnp.zeros((), jnp.int32),
     )

@@ -142,19 +142,46 @@ class GLMProblem:
     ) -> Callable[[Array], tuple[Array, Array]]:
         return lambda w: self.objective.value_and_gradient(w, batch)
 
-    def solve(self, batch: LabeledBatch, w0: Array) -> OptimizeResult:
+    def objective_for_weight(self, reg_weight) -> GLMObjective:
+        """Objective with l1/l2 recomputed from a (possibly traced) λ.
+
+        The regularization *type* stays static so jit control flow is stable
+        across a λ grid; only the weight values are data. This is the traced
+        analogue of the reference's mutable reg weight
+        (DistributedOptimizationProblem.scala:62-73, OWLQN.scala:70-85).
+        """
+        if reg_weight is None:
+            return self.objective
+        return dataclasses.replace(
+            self.objective,
+            l1_weight=self.config.regularization.l1_weight(reg_weight),
+            l2_weight=self.config.regularization.l2_weight(reg_weight),
+        )
+
+    def solve(
+        self, batch: LabeledBatch, w0: Array, reg_weight=None
+    ) -> OptimizeResult:
+        """Run the solve. ``reg_weight`` may be a traced scalar: passing the
+        λ-grid value here (instead of rebuilding the problem per λ) keeps one
+        compiled program per coordinate across the whole grid."""
         cfg = self.config.optimizer_config
-        vg = self.value_and_gradient_fn(batch)
+        objective = self.objective_for_weight(reg_weight)
+        vg = lambda w: objective.value_and_gradient(w, batch)  # noqa: E731
         opt = self.config.optimizer
-        use_owlqn = self.objective.l1_weight > 0 or opt == OptimizerType.OWLQN
-        if use_owlqn:
-            return minimize_owlqn(vg, w0, self.objective.l1_weight, cfg)
+        # Static dispatch: branch on the regularization TYPE (not the traced
+        # weight value) so the λ grid reuses one compiled program.
+        has_l1 = self.config.regularization.regularization_type in (
+            RegularizationType.L1,
+            RegularizationType.ELASTIC_NET,
+        )
+        if has_l1 or opt == OptimizerType.OWLQN:
+            return minimize_owlqn(vg, w0, objective.l1_weight, cfg)
         if opt == OptimizerType.TRON:
             if cfg == OptimizerConfig():
                 cfg = cfg.tron_defaults()
             return minimize_tron(
                 vg,
-                lambda w, v: self.objective.hessian_vector(w, v, batch),
+                lambda w, v: objective.hessian_vector(w, v, batch),
                 w0,
                 cfg,
             )

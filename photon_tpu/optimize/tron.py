@@ -49,10 +49,11 @@ def _truncated_cg(
     *,
     max_iterations: int,
     tolerance: float,
-) -> tuple[Array, Array]:
+) -> tuple[Array, Array, Array]:
     """Solve min_d g·d + d·H·d/2 s.t. ‖d‖ ≤ delta, approximately.
 
-    Returns (d, r) with r the final residual -g - H·d
+    Returns (d, r, n_hvp) with r the final residual -g - H·d and n_hvp the
+    number of Hessian-vector products spent
     (TRON.truncatedConjugateGradientMethod, TRON.scala:278-339).
     """
     dtype = g.dtype
@@ -118,7 +119,7 @@ def _truncated_cg(
         )
 
     s = lax.while_loop(cond, body, init)
-    return s.d, s.r
+    return s.d, s.r, s.i
 
 
 class _TronState(NamedTuple):
@@ -130,6 +131,8 @@ class _TronState(NamedTuple):
     reason: Array
     loss_hist: Array
     gnorm_hist: Array
+    n_evals: Array
+    n_hvp: Array
 
 
 def minimize_tron(
@@ -168,13 +171,15 @@ def minimize_tron(
         reason=jnp.zeros((), jnp.int32),
         loss_hist=jnp.full((t + 1,), f0, dtype),
         gnorm_hist=jnp.full((t + 1,), gnorm0, dtype),
+        n_evals=jnp.asarray(2, jnp.int32),  # zero-state + initial point
+        n_hvp=jnp.zeros((), jnp.int32),
     )
 
     def cond(s: _TronState):
         return s.reason == ConvergenceReason.NOT_CONVERGED
 
     def body(s: _TronState) -> _TronState:
-        step, r = _truncated_cg(
+        step, r, cg_iters = _truncated_cg(
             lambda v: hvp(s.x, v),
             s.g,
             s.delta,
@@ -246,6 +251,8 @@ def minimize_tron(
             reason=reason,
             loss_hist=s.loss_hist.at[it].set(f_out),
             gnorm_hist=s.gnorm_hist.at[it].set(gnorm_out),
+            n_evals=s.n_evals + 1,
+            n_hvp=s.n_hvp + cg_iters,
         )
 
     s = lax.while_loop(cond, body, init)
@@ -262,4 +269,6 @@ def minimize_tron(
         reason=s.reason,
         loss_history=loss_hist,
         grad_norm_history=gnorm_hist,
+        n_evals=s.n_evals,
+        n_hvp=s.n_hvp,
     )

@@ -576,7 +576,7 @@ def test_game_training_and_scoring_with_mf_coordinate(tmp_path):
             "--evaluators", "AUC",
         ]
     )
-    assert res["results"][0]["evaluation"] > 0.7
+    assert res["results"][0].evaluation > 0.7
     assert (out / "best" / "matrix-factorization" / "mf" / "id-info").exists()
 
     score_out = tmp_path / "scoring"
