@@ -28,6 +28,21 @@ class ProjectorType(enum.Enum):
     IDENTITY = "IDENTITY"
 
 
+class FeatureRepresentation(enum.Enum):
+    """Device layout of a fixed-effect feature block.
+
+    DENSE keeps [N, D] on the MXU (right for small/dense shards); SPARSE is
+    padded-ELL gather/scatter (right for high-dim sparse shards — the
+    reference's aggregators preserve sparsity the same way,
+    ValueAndGradientAggregator.scala:36-80); AUTO picks SPARSE when the
+    dense block would be large and mostly zeros.
+    """
+
+    DENSE = "DENSE"
+    SPARSE = "SPARSE"
+    AUTO = "AUTO"
+
+
 @dataclasses.dataclass(frozen=True)
 class FixedEffectCoordinateConfig:
     """One fixed-effect coordinate: whole-dataset GLM on a feature shard."""
@@ -35,6 +50,7 @@ class FixedEffectCoordinateConfig:
     feature_shard: str
     optimization: GLMProblemConfig
     regularization_weights: Sequence[float] = (0.0,)
+    representation: FeatureRepresentation = FeatureRepresentation.AUTO
 
     @property
     def is_random_effect(self) -> bool:

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.game.config import (
+    FeatureRepresentation,
     FixedEffectCoordinateConfig,
     MatrixFactorizationCoordinateConfig,
     RandomEffectCoordinateConfig,
@@ -41,8 +42,18 @@ from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import model_for_task
 from photon_tpu.ops.losses import POSITIVE_RESPONSE_THRESHOLD
 from photon_tpu.ops.normalization import NormalizationContext
+from photon_tpu.data.dataset import choose_sparse
+from photon_tpu.ops.objective import matvec
 from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
-from photon_tpu.types import Array, LabeledBatch
+from photon_tpu.types import Array, LabeledBatch, SparseBatch
+
+
+def _use_sparse(representation: FeatureRepresentation, shard) -> bool:
+    if representation == FeatureRepresentation.SPARSE:
+        return True
+    if representation == FeatureRepresentation.DENSE:
+        return False
+    return choose_sparse(shard.num_rows, shard.num_cols, len(shard.values))
 
 
 class Coordinate:
@@ -66,10 +77,11 @@ class Coordinate:
 class FixedEffectCoordinate(Coordinate):
     config: FixedEffectCoordinateConfig
     feature_shard: str
-    batch: LabeledBatch  # device, offsets = raw data offsets
+    batch: LabeledBatch | SparseBatch  # device, offsets = raw data offsets
     normalization: NormalizationContext
     problem: GLMProblem
     dtype: object
+    num_features: int = 0
 
     @staticmethod
     def build(
@@ -100,12 +112,22 @@ class FixedEffectCoordinate(Coordinate):
                 weights[~keep_draw] = 0.0
         # numpy handles bfloat16 via ml_dtypes, so one host-side conversion
         # covers every supported dtype
-        batch = LabeledBatch(
-            features=shard.to_dense(dtype=dtype),
-            labels=np.asarray(data.labels, dtype=dtype),
-            offsets=np.asarray(data.offsets, dtype=dtype),
-            weights=np.asarray(weights, dtype=dtype),
-        )
+        if _use_sparse(config.representation, shard):
+            ell_idx, ell_val = shard.to_ell(dtype=dtype)
+            batch = SparseBatch(
+                indices=ell_idx,
+                values=ell_val,
+                labels=np.asarray(data.labels, dtype=dtype),
+                offsets=np.asarray(data.offsets, dtype=dtype),
+                weights=np.asarray(weights, dtype=dtype),
+            )
+        else:
+            batch = LabeledBatch(
+                features=shard.to_dense(dtype=dtype),
+                labels=np.asarray(data.labels, dtype=dtype),
+                offsets=np.asarray(data.offsets, dtype=dtype),
+                weights=np.asarray(weights, dtype=dtype),
+            )
         if mesh is not None:
             from photon_tpu.parallel.mesh import shard_batch
 
@@ -115,8 +137,12 @@ class FixedEffectCoordinate(Coordinate):
             # holds the whole [N, D] block.
             batch = shard_batch(batch, mesh)
         else:
+            # preserve integer leaves (sparse ELL indices) as-is
             batch = jax.tree_util.tree_map(
-                lambda x: jnp.asarray(x, dtype=dtype), batch
+                lambda x: jnp.asarray(x)
+                if np.issubdtype(np.asarray(x).dtype, np.integer)
+                else jnp.asarray(x, dtype=dtype),
+                batch,
             )
         problem = GLMProblem.build(
             config.optimization.with_regularization_weight(
@@ -131,6 +157,7 @@ class FixedEffectCoordinate(Coordinate):
             normalization=normalization,
             problem=problem,
             dtype=dtype,
+            num_features=shard.num_cols,
         )
 
     def with_regularization_weight(self, w: float) -> "FixedEffectCoordinate":
@@ -146,7 +173,7 @@ class FixedEffectCoordinate(Coordinate):
         return self
 
     def initial_state(self) -> Array:
-        return jnp.zeros((self.batch.num_features,), dtype=self.dtype)
+        return jnp.zeros((self.num_features,), dtype=self.dtype)
 
     @partial(jax.jit, static_argnums=0)
     def _train_jit(self, residual_scores: Array, w0: Array, reg_weight: Array):
@@ -170,7 +197,7 @@ class FixedEffectCoordinate(Coordinate):
         """x·(w .* factor) + margin shift — the coordinate's contribution,
         exclusive of data offsets (FixedEffectCoordinate.score:158-166)."""
         eff = self.normalization.effective_coefficients(state)
-        s = self.batch.features @ eff
+        s = matvec(self.batch, eff)
         if self.normalization.shifts is not None:
             s = s + self.normalization.margin_shift(state)
         return s

@@ -15,13 +15,18 @@ from typing import Sequence
 
 import jax.numpy as jnp
 
-from photon_tpu.data.dataset import DataSet, to_device_batch
+from photon_tpu.data.dataset import (
+    DataSet,
+    choose_sparse,
+    to_device_batch,
+    to_device_sparse_batch,
+)
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel, model_for_task
 from photon_tpu.ops.normalization import NormalizationContext
 from photon_tpu.optimize.common import OptimizeResult
 from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
-from photon_tpu.types import Array, LabeledBatch
+from photon_tpu.types import Array, LabeledBatch, SparseBatch
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,7 +41,7 @@ class TrainedModel:
 
 
 def train_glm_grid(
-    data: DataSet | LabeledBatch,
+    data: DataSet | LabeledBatch | SparseBatch,
     base_config: GLMProblemConfig,
     regularization_weights: Sequence[float],
     *,
@@ -44,6 +49,7 @@ def train_glm_grid(
     warm_start: bool = True,
     initial_coefficients: Array | None = None,
     dtype=jnp.float32,
+    num_features: int | None = None,
 ) -> list[TrainedModel]:
     """Train one GLM per λ, descending the grid with warm starts.
 
@@ -51,21 +57,36 @@ def train_glm_grid(
     less-regularized problem (ModelTraining.scala:165+); we preserve the
     caller's order but chain coefficients the same way.
 
+    A ``DataSet`` is laid out dense or sparse-ELL automatically
+    (``choose_sparse``); callers passing a pre-built ``SparseBatch`` must
+    supply ``num_features`` (the ELL layout does not carry it).
+
     Models are returned in the *original space* (normalization undone),
     like the reference's post-optimization conversion.
     """
-    batch = (
-        data
-        if isinstance(data, LabeledBatch)
-        else to_device_batch(data, dtype=dtype)
-    )
-    d = batch.num_features
+    use_sparse = False
+    if isinstance(data, (LabeledBatch, SparseBatch)):
+        batch = data
+        use_sparse = isinstance(data, SparseBatch)
+        if use_sparse and num_features is None:
+            raise ValueError("num_features is required with a SparseBatch")
+        d = num_features if use_sparse else batch.num_features
+    else:
+        use_sparse = choose_sparse(
+            data.num_samples, data.num_features, len(data.values)
+        )
+        batch = (
+            to_device_sparse_batch(data, dtype=dtype)
+            if use_sparse
+            else to_device_batch(data, dtype=dtype)
+        )
+        d = data.num_features
 
     results: list[TrainedModel] = []
     w = (
-        jnp.zeros((d,), dtype=batch.features.dtype)
+        jnp.zeros((d,), dtype=dtype)
         if initial_coefficients is None
-        else jnp.asarray(initial_coefficients, dtype=batch.features.dtype)
+        else jnp.asarray(initial_coefficients, dtype=dtype)
     )
     # Optimization happens in the transformed space.
     w = normalization.model_to_transformed_space(w)
@@ -77,7 +98,12 @@ def train_glm_grid(
         sampler = problem.down_sampler()
         solve_batch = batch
         if sampler is not None and isinstance(data, DataSet):
-            solve_batch = to_device_batch(sampler.downsample(data), dtype=dtype)
+            sampled = sampler.downsample(data)
+            solve_batch = (
+                to_device_sparse_batch(sampled, dtype=dtype)
+                if use_sparse
+                else to_device_batch(sampled, dtype=dtype)
+            )
 
         t0 = time.perf_counter()
         result = problem.solve(solve_batch, w)

@@ -26,11 +26,41 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from photon_tpu.ops.losses import PointwiseLoss
 from photon_tpu.ops.normalization import NormalizationContext
-from photon_tpu.types import Array, LabeledBatch
+from photon_tpu.types import Array, LabeledBatch, SparseBatch
+
+
+def matvec(batch, v: Array) -> Array:
+    """X·v for either batch layout.
+
+    Dense: one MXU matmul. Sparse ELL: gather the K coefficient slots per row
+    and row-sum — padding slots hold value 0 so they vanish. This (plus
+    ``rmatvec``) is how the sparse path preserves the reference aggregator's
+    never-densify property (ValueAndGradientAggregator.scala:36-80) on TPU.
+    """
+    if isinstance(batch, SparseBatch):
+        return jnp.sum(v[batch.indices] * batch.values, axis=-1)
+    return batch.features @ v
+
+
+def rmatvec(batch, per_row: Array, dim: int) -> Array:
+    """Xᵀ·per_row for either batch layout (``dim`` = static feature count,
+    always taken from the coefficient vector's shape).
+
+    Sparse ELL: flat scatter-add over the N·K (index, value·r) pairs. Under
+    pjit with rows sharded, each shard scatters into its own [dim] partial
+    and XLA inserts the psum — same collective the dense Xᵀr gets.
+    """
+    if isinstance(batch, SparseBatch):
+        flat = (batch.values * per_row[:, None]).reshape(-1)
+        return jax.ops.segment_sum(
+            flat, batch.indices.reshape(-1), num_segments=dim
+        )
+    return batch.features.T @ per_row
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,20 +80,22 @@ class GLMObjective:
 
     # --- margins ----------------------------------------------------------
 
-    def margins(self, coef: Array, batch: LabeledBatch) -> Array:
+    def margins(self, coef: Array, batch) -> Array:
         eff = self.normalization.effective_coefficients(coef)
-        z = batch.features @ eff + batch.offsets
+        z = matvec(batch, eff) + batch.offsets
         if self.normalization.shifts is not None:
             z = z + self.normalization.margin_shift(coef)
         return z
 
-    def _back(self, per_row: Array, batch: LabeledBatch) -> Array:
+    def _back(self, per_row: Array, batch, dim: int) -> Array:
         """Xᵀ·per_row, mapped back through the normalization transform.
 
         d margin/d coef = factor .* (x − shift), with factor ≡ 1 when only
-        shifts are set.
+        shifts are set. The shift correction is the margin-shift algebra that
+        keeps the sparse path sparse (reference
+        ValueAndGradientAggregator.scala:36-80).
         """
-        g = batch.features.T @ per_row
+        g = rmatvec(batch, per_row, dim)
         if self.normalization.shifts is not None:
             g = g - jnp.sum(per_row) * self.normalization.shifts
         if self.normalization.factors is not None:
@@ -72,62 +104,101 @@ class GLMObjective:
 
     # --- value / gradient -------------------------------------------------
 
-    def value(self, coef: Array, batch: LabeledBatch) -> Array:
+    def value(self, coef: Array, batch) -> Array:
         z = self.margins(coef, batch)
         raw = jnp.sum(batch.weights * self.loss.loss(z, batch.labels))
         return raw + 0.5 * self.l2_weight * jnp.dot(coef, coef)
 
-    def gradient(self, coef: Array, batch: LabeledBatch) -> Array:
+    def gradient(self, coef: Array, batch) -> Array:
         return self.value_and_gradient(coef, batch)[1]
 
-    def value_and_gradient(
-        self, coef: Array, batch: LabeledBatch
-    ) -> tuple[Array, Array]:
+    def value_and_gradient(self, coef: Array, batch) -> tuple[Array, Array]:
         z = self.margins(coef, batch)
         losses, d1 = self.loss.loss_and_d1(z, batch.labels)
         value = jnp.sum(batch.weights * losses) + 0.5 * self.l2_weight * jnp.dot(
             coef, coef
         )
-        grad = self._back(batch.weights * d1, batch) + self.l2_weight * coef
+        grad = (
+            self._back(batch.weights * d1, batch, coef.shape[-1])
+            + self.l2_weight * coef
+        )
         return value, grad
 
     # --- second order -----------------------------------------------------
 
-    def hessian_vector(self, coef: Array, v: Array, batch: LabeledBatch) -> Array:
+    def hessian_vector(self, coef: Array, v: Array, batch) -> Array:
         """H·v via one forward + one backward matmul (no O(D²) memory)."""
         z = self.margins(coef, batch)
         d2 = self.loss.d2(z, batch.labels)
-        eff_v = self.normalization.effective_coefficients(v)
-        xv = batch.features @ eff_v
+        xv = matvec(batch, self.normalization.effective_coefficients(v))
         if self.normalization.shifts is not None:
             xv = xv + self.normalization.margin_shift(v)
-        return self._back(batch.weights * d2 * xv, batch) + self.l2_weight * v
+        return (
+            self._back(batch.weights * d2 * xv, batch, coef.shape[-1])
+            + self.l2_weight * v
+        )
 
-    def hessian_matrix(self, coef: Array, batch: LabeledBatch) -> Array:
-        """Dense D×D Hessian (used for coefficient variances on small D)."""
+    def hessian_matrix(self, coef: Array, batch) -> Array:
+        """Dense D×D Hessian (used for coefficient variances on small D;
+        a sparse batch is densified here — FULL variance is O(D²) memory
+        regardless, so it is only reachable when D is small anyway)."""
         z = self.margins(coef, batch)
         d2 = batch.weights * self.loss.d2(z, batch.labels)
-        x = self._transformed_features(batch)
+        x = self._transformed_features(batch, coef.shape[-1])
         h = x.T @ (d2[:, None] * x)
         d = coef.shape[-1]
         return h + self.l2_weight * jnp.eye(d, dtype=h.dtype)
 
-    def _transformed_features(self, batch: LabeledBatch) -> Array:
+    def _transformed_features(self, batch, dim: int) -> Array:
         """Materialized x' = (x − shift) .* factor (only for the dense-Hessian
         paths, where D is small)."""
-        x = batch.features
+        if isinstance(batch, SparseBatch):
+            n = batch.indices.shape[0]
+            rows = jnp.arange(n, dtype=batch.indices.dtype)[:, None]
+            x = (
+                jnp.zeros((n, dim), dtype=batch.values.dtype)
+                .at[rows, batch.indices]
+                .add(batch.values)
+            )
+        else:
+            x = batch.features
         if self.normalization.shifts is not None:
             x = x - self.normalization.shifts
         if self.normalization.factors is not None:
             x = x * self.normalization.factors
         return x
 
-    def hessian_diagonal(self, coef: Array, batch: LabeledBatch) -> Array:
+    def hessian_diagonal(self, coef: Array, batch) -> Array:
         """diag(H) without materializing H (reference uses it for variance
-        approximation, DistributedOptimizationProblem.scala:82-96)."""
+        approximation, DistributedOptimizationProblem.scala:82-96).
+
+        Sparse path stays sparse via the binomial expansion
+        Σᵢ sᵢ(xᵢⱼ−shiftⱼ)² = Σᵢ sᵢxᵢⱼ² − 2·shiftⱼ·Σᵢ sᵢxᵢⱼ + shiftⱼ²·Σᵢ sᵢ
+        — two segment-sums plus a scalar, no densification.
+        """
         z = self.margins(coef, batch)
         d2 = batch.weights * self.loss.d2(z, batch.labels)
-        x = self._transformed_features(batch)
+        dim = coef.shape[-1]
+        if isinstance(batch, SparseBatch):
+            flat_idx = batch.indices.reshape(-1)
+            sq = jax.ops.segment_sum(
+                (jnp.square(batch.values) * d2[:, None]).reshape(-1),
+                flat_idx,
+                num_segments=dim,
+            )
+            if self.normalization.shifts is not None:
+                lin = jax.ops.segment_sum(
+                    (batch.values * d2[:, None]).reshape(-1),
+                    flat_idx,
+                    num_segments=dim,
+                )
+                shifts = self.normalization.shifts
+                sq = sq - 2.0 * shifts * lin + jnp.square(shifts) * jnp.sum(d2)
+            diag = sq
+            if self.normalization.factors is not None:
+                diag = diag * jnp.square(self.normalization.factors)
+            return diag + self.l2_weight
+        x = self._transformed_features(batch, dim)
         return jnp.sum(d2[:, None] * jnp.square(x), axis=0) + self.l2_weight
 
     # --- helpers ----------------------------------------------------------

@@ -26,7 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from photon_tpu.types import LabeledBatch, PyTree
+from photon_tpu.types import LabeledBatch, PyTree, SparseBatch
 
 BATCH_AXIS = "data"
 ENTITY_AXIS = "entity"
@@ -55,13 +55,24 @@ def make_mesh(
     return Mesh(arr, (BATCH_AXIS, ENTITY_AXIS))
 
 
-def shard_batch(batch: LabeledBatch, mesh: Mesh) -> LabeledBatch:
-    """Place a batch with rows sharded over every mesh device (features'
-    feature-dimension replicated). Rows spread over both axes so a
-    fixed-effect solve uses the whole mesh, not just the data axis."""
+def shard_batch(batch, mesh: Mesh):
+    """Place a batch with rows sharded over every mesh device (the feature
+    dimension replicated). Rows spread over both axes so a fixed-effect solve
+    uses the whole mesh, not just the data axis. Works for both layouts: a
+    sparse batch's [N, K] index/value blocks shard on rows exactly like the
+    dense [N, D] block; the scatter-add output ([D]) is replicated, with XLA
+    inserting the psum."""
     axes = tuple(mesh.axis_names)
     row_sharded = NamedSharding(mesh, P(axes))
     mat_sharded = NamedSharding(mesh, P(axes, None))
+    if isinstance(batch, SparseBatch):
+        return SparseBatch(
+            indices=jax.device_put(batch.indices, mat_sharded),
+            values=jax.device_put(batch.values, mat_sharded),
+            labels=jax.device_put(batch.labels, row_sharded),
+            offsets=jax.device_put(batch.offsets, row_sharded),
+            weights=jax.device_put(batch.weights, row_sharded),
+        )
     return LabeledBatch(
         features=jax.device_put(batch.features, mat_sharded),
         labels=jax.device_put(batch.labels, row_sharded),
