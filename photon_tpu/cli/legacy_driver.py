@@ -20,6 +20,7 @@ import json
 import os
 import sys
 
+import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.data.dataset import DataSet
@@ -187,13 +188,25 @@ class LegacyDriver:
         metric_types = [_DEFAULT_METRIC[task]]
         if task == TaskType.LOGISTIC_REGRESSION:
             metric_types.append(EvaluatorType.LOGISTIC_LOSS)
-        from photon_tpu.data.dataset import to_device_batch
+        from photon_tpu.data.dataset import (
+            choose_sparse,
+            to_device_batch,
+            to_device_sparse_batch,
+        )
+        from photon_tpu.ops.objective import matvec
 
-        batch = to_device_batch(data)
+        # Keep the layout the training path chose: a shard big enough to
+        # train sparse must also be scored sparse, or validation re-allocates
+        # the dense block training avoided.
+        if choose_sparse(data.num_samples, data.num_features, len(data.values)):
+            batch = to_device_sparse_batch(data)
+        else:
+            batch = to_device_batch(data)
         best_val, best_i = None, 0
         primary = metric_types[0]
         for i, tm in enumerate(self.models):
-            margins = tm.model.compute_margin(batch.features, batch.offsets)
+            means = jnp.asarray(tm.model.coefficients.means)
+            margins = matvec(batch, means) + batch.offsets
             row = {
                 m.name: float(
                     evaluate(m, margins, batch.labels, batch.weights)

@@ -120,16 +120,46 @@ AUTO_SPARSE_DENSE_BYTES = 1 << 28  # 256 MiB
 AUTO_SPARSE_MAX_DENSITY = 0.25
 
 
-def choose_sparse(num_rows: int, num_cols: int, nnz: int) -> bool:
+def choose_sparse(
+    num_rows: int, num_cols: int, nnz: int, itemsize: int = 4
+) -> bool:
     """The AUTO dense-vs-sparse layout rule (shared by the fixed-effect
-    coordinate and the legacy GLM path)."""
+    coordinate and the legacy GLM path). ``itemsize`` is the device dtype's
+    bytes-per-element so the threshold tracks the actual footprint."""
     cells = num_rows * num_cols
     if cells == 0:
         return False
     return (
-        4 * cells > AUTO_SPARSE_DENSE_BYTES
+        itemsize * cells > AUTO_SPARSE_DENSE_BYTES
         and nnz / cells < AUTO_SPARSE_MAX_DENSITY
     )
+
+
+def csr_to_ell(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    values: np.ndarray,
+    dtype=np.float32,
+    nnz_pad_multiple: int = 8,
+    num_rows_padded: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """CSR → padded-ELL (indices [N, K] int32, values [N, K]) without
+    densifying; K = max nnz/row rounded up to ``nnz_pad_multiple``. Padding
+    slots are (index 0, value 0.0) — a zero value vanishes from every
+    gather/scatter product, so no masks are needed. One vectorized scatter.
+    """
+    n = indptr.shape[0] - 1
+    counts = np.diff(indptr)
+    k_raw = max(int(counts.max()) if n else 1, 1)
+    k = _round_up(k_raw, nnz_pad_multiple)
+    n_out = n if num_rows_padded is None else num_rows_padded
+    out_idx = np.zeros((n_out, k), dtype=np.int32)
+    out_val = np.zeros((n_out, k), dtype=dtype)
+    rows = np.repeat(np.arange(n), counts)
+    slots = np.arange(int(indptr[-1])) - np.repeat(indptr[:-1], counts)
+    out_idx[rows, slots] = indices
+    out_val[rows, slots] = values
+    return out_idx, out_val
 
 
 def pad_batch(batch: LabeledBatch, target_rows: int) -> LabeledBatch:
@@ -187,16 +217,15 @@ def to_device_sparse_batch(
     selection, LocalDataSet.scala:135-160).
     """
     n = data.num_samples
-    counts = np.diff(data.indptr)
-    k = _round_up(max(int(counts.max()) if n else 1, 1), nnz_pad_multiple)
     n_pad = _round_up(max(n, 1), pad_to_multiple)
-    indices = np.zeros((n_pad, k), dtype=np.int32)
-    values = np.zeros((n_pad, k), dtype=np.float64)
-    # One vectorized scatter: slot position of every stored nonzero.
-    rows = np.repeat(np.arange(n), counts)
-    slots = np.arange(int(data.indptr[-1])) - np.repeat(data.indptr[:-1], counts)
-    indices[rows, slots] = data.indices
-    values[rows, slots] = data.values
+    indices, values = csr_to_ell(
+        data.indptr,
+        data.indices,
+        data.values,
+        dtype=np.dtype(dtype),
+        nnz_pad_multiple=nnz_pad_multiple,
+        num_rows_padded=n_pad,
+    )
     pad = n_pad - n
     return SparseBatch(
         indices=jnp.asarray(indices),
@@ -205,6 +234,24 @@ def to_device_sparse_batch(
         offsets=jnp.asarray(np.pad(data.offsets, (0, pad)), dtype=dtype),
         weights=jnp.asarray(np.pad(data.weights, (0, pad)), dtype=dtype),
     )
+
+
+def to_device_auto_batch(
+    data: DataSet, dtype=jnp.float32, pad_to_multiple: int = 8
+) -> LabeledBatch | SparseBatch:
+    """Move a DataSet to device in whichever layout ``choose_sparse``
+    picks — the one entry point for code that must never densify a shard
+    the training path kept sparse (validation, diagnostics)."""
+    if choose_sparse(
+        data.num_samples,
+        data.num_features,
+        len(data.values),
+        itemsize=jnp.dtype(dtype).itemsize,
+    ):
+        return to_device_sparse_batch(
+            data, dtype=dtype, pad_to_multiple=pad_to_multiple
+        )
+    return to_device_batch(data, dtype=dtype, pad_to_multiple=pad_to_multiple)
 
 
 def train_validation_split(

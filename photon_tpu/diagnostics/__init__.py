@@ -92,10 +92,10 @@ def diagnose_models(
     Returns a JSON-able report dict; writes ``report.html`` / ``report.txt``
     / ``report.json`` under ``output_dir`` when given.
     """
-    from photon_tpu.data.dataset import to_device_batch
+    from photon_tpu.data.dataset import to_device_auto_batch
     from photon_tpu.optimize.problem import GLMProblemConfig
 
-    batch = to_device_batch(data)
+    batch = to_device_auto_batch(data)
     n = data.num_samples
     report: dict = {"task": task.value, "models": []}
     chapters: list[Chapter] = []
@@ -145,9 +145,7 @@ def diagnose_models(
         for name, v in metrics.items():
             primary_curve.setdefault(name, []).append(v)
 
-        margins = np.asarray(
-            model.compute_margin(batch.features, batch.offsets)
-        )[:n]
+        margins = np.asarray(model.compute_margin_batch(batch))[:n]
         means = np.asarray(model.compute_mean(margins))
 
         if task == TaskType.LOGISTIC_REGRESSION:
@@ -214,8 +212,7 @@ def diagnose_models(
 
         imp = importance_from_batch(
             np.asarray(model.coefficients.means),
-            batch.features,
-            batch.weights,
+            batch,
             num_samples=n,
             top_k=20,
             index_to_name=index_to_name,
@@ -283,7 +280,7 @@ def diagnose_models(
         best = models[min(best_index, len(models) - 1)]
         base = config if config is not None else GLMProblemConfig(task=task)
         config = base.with_regularization_weight(best.regularization_weight)
-        train_batch = to_device_batch(train_data)
+        train_batch = to_device_auto_batch(train_data)
         n_train = train_data.num_samples
 
         fit = fitting_diagnostic(
@@ -296,6 +293,7 @@ def diagnose_models(
             fractions=list(fitting_fractions),
             normalization=normalization,
             seed=seed,
+            num_features=train_data.num_features,
         )
         report["fitting"] = {
             "fractions": fit.fractions,
@@ -339,6 +337,7 @@ def diagnose_models(
                 num_replicates=bootstrap_replicates,
                 normalization=normalization,
                 seed=seed,
+                num_features=train_data.num_features,
             )
             report["bootstrap"] = {
                 "replicates": boot.num_replicates,

@@ -63,6 +63,7 @@ def bootstrap_diagnostic(
     metric_names: Sequence[str] | None = None,
     normalization=None,
     seed: int = 0,
+    num_features: int | None = None,
 ) -> BootstrapReport:
     """Run B reweighted retrains and summarize coefficient stability.
 
@@ -86,13 +87,14 @@ def bootstrap_diagnostic(
         config,
         [config.regularization_weight],
         warm_start=False,
+        num_features=num_features,
         **norm_kw,
     )
     point_means = np.asarray(point.model.coefficients.means, dtype=np.float64)
 
     coef_draws = np.zeros((num_replicates, point_means.shape[0]))
     metric_draws: list[dict[str, float]] = []
-    warm = jnp.asarray(point_means, dtype=train_batch.features.dtype)
+    warm = jnp.asarray(point_means, dtype=train_batch.labels.dtype)
     for b in range(num_replicates):
         counts = np.zeros(n_total)
         counts[:num_samples] = rng.multinomial(
@@ -109,6 +111,7 @@ def bootstrap_diagnostic(
             [config.regularization_weight],
             warm_start=False,
             initial_coefficients=warm,
+            num_features=num_features,
             **norm_kw,
         )
         coef_draws[b] = np.asarray(tm.model.coefficients.means)

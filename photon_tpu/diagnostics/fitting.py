@@ -40,6 +40,7 @@ def fitting_diagnostic(
     fractions: list[float] | None = None,
     normalization=None,
     seed: int = 0,
+    num_features: int | None = None,
 ) -> FittingReport:
     import jax.numpy as jnp
 
@@ -71,11 +72,12 @@ def fitting_diagnostic(
             [config.regularization_weight],
             warm_start=False,
             initial_coefficients=warm,
+            num_features=num_features,
             **norm_kw,
         )
         warm = jnp.asarray(
             np.asarray(tm.model.coefficients.means),
-            dtype=train_batch.features.dtype,
+            dtype=train_batch.labels.dtype,
         )
         on_train = compute_metrics(tm.model, masked, task, num_samples=n_total)
         on_test = compute_metrics(

@@ -74,24 +74,57 @@ def feature_importance(
 
 def importance_from_batch(
     coefficients: np.ndarray,
-    features,
-    weights,
+    batch,
     num_samples: int | None = None,
     *,
     top_k: int = 50,
     index_to_name=None,
 ) -> ImportanceReport:
-    """Compute column moments from a device batch, then rank."""
+    """Compute column moments from a device batch (either layout), then rank.
+
+    Sparse-ELL moments come from segment-sums over the stored slots; the
+    implicit zeros contribute nothing to Σw|x|, Σwx, Σwx², and the weight
+    total runs over all rows — so the moments match the dense computation
+    exactly without densifying.
+    """
+    import jax
     import jax.numpy as jnp
 
-    x = features if num_samples is None else features[:num_samples]
-    w = weights if num_samples is None else weights[:num_samples]
-    total_w = jnp.maximum(jnp.sum(w), 1e-30)
-    mean_abs = jnp.sum(w[:, None] * jnp.abs(x), axis=0) / total_w
-    mean = jnp.sum(w[:, None] * x, axis=0) / total_w
-    var = jnp.sum(w[:, None] * (x - mean) ** 2, axis=0) / total_w
+    from photon_tpu.types import SparseBatch
+
+    coefficients = np.asarray(coefficients)
+    d = coefficients.shape[-1]
+    if isinstance(batch, SparseBatch):
+        idx = batch.indices if num_samples is None else batch.indices[:num_samples]
+        val = batch.values if num_samples is None else batch.values[:num_samples]
+        w = batch.weights if num_samples is None else batch.weights[:num_samples]
+        total_w = jnp.maximum(jnp.sum(w), 1e-30)
+        flat_idx = idx.reshape(-1)
+        wv = val * w[:, None]
+        mean_abs = (
+            jax.ops.segment_sum(jnp.abs(wv).reshape(-1), flat_idx, num_segments=d)
+            / total_w
+        )
+        mean = (
+            jax.ops.segment_sum(wv.reshape(-1), flat_idx, num_segments=d)
+            / total_w
+        )
+        ex2 = (
+            jax.ops.segment_sum(
+                (wv * val).reshape(-1), flat_idx, num_segments=d
+            )
+            / total_w
+        )
+        var = ex2 - jnp.square(mean)
+    else:
+        x = batch.features if num_samples is None else batch.features[:num_samples]
+        w = batch.weights if num_samples is None else batch.weights[:num_samples]
+        total_w = jnp.maximum(jnp.sum(w), 1e-30)
+        mean_abs = jnp.sum(w[:, None] * jnp.abs(x), axis=0) / total_w
+        mean = jnp.sum(w[:, None] * x, axis=0) / total_w
+        var = jnp.sum(w[:, None] * (x - mean) ** 2, axis=0) / total_w
     return feature_importance(
-        np.asarray(coefficients),
+        coefficients,
         np.asarray(mean_abs),
         np.sqrt(np.maximum(np.asarray(var), 0.0)),
         top_k=top_k,

@@ -112,7 +112,7 @@ def compute_metrics(
     ``num_samples`` trims device padding rows; defaults to the full batch.
     """
     n = num_samples if num_samples is not None else int(batch.labels.shape[0])
-    margins_dev = model.compute_margin(batch.features, batch.offsets)
+    margins_dev = model.compute_margin_batch(batch)
     margins = _trim(margins_dev, n).astype(np.float64)
     means = _trim(model.compute_mean(margins_dev), n).astype(np.float64)
     labels = _trim(batch.labels, n).astype(np.float64)

@@ -48,12 +48,17 @@ from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
 from photon_tpu.types import Array, LabeledBatch, SparseBatch
 
 
-def _use_sparse(representation: FeatureRepresentation, shard) -> bool:
+def _use_sparse(representation: FeatureRepresentation, shard, dtype) -> bool:
     if representation == FeatureRepresentation.SPARSE:
         return True
     if representation == FeatureRepresentation.DENSE:
         return False
-    return choose_sparse(shard.num_rows, shard.num_cols, len(shard.values))
+    return choose_sparse(
+        shard.num_rows,
+        shard.num_cols,
+        len(shard.values),
+        itemsize=jnp.dtype(dtype).itemsize,
+    )
 
 
 class Coordinate:
@@ -81,7 +86,7 @@ class FixedEffectCoordinate(Coordinate):
     normalization: NormalizationContext
     problem: GLMProblem
     dtype: object
-    num_features: int = 0
+    num_features: int
 
     @staticmethod
     def build(
@@ -112,7 +117,7 @@ class FixedEffectCoordinate(Coordinate):
                 weights[~keep_draw] = 0.0
         # numpy handles bfloat16 via ml_dtypes, so one host-side conversion
         # covers every supported dtype
-        if _use_sparse(config.representation, shard):
+        if _use_sparse(config.representation, shard, dtype):
             ell_idx, ell_val = shard.to_ell(dtype=dtype)
             batch = SparseBatch(
                 indices=ell_idx,

@@ -63,21 +63,16 @@ class CSRMatrix:
         self, dtype=np.float32, nnz_pad_multiple: int = 8
     ) -> tuple[np.ndarray, np.ndarray]:
         """CSR → padded-ELL (indices [N, K] int32, values [N, K]) without
-        densifying; K = max nnz/row rounded up. Padding slots are (0, 0.0) —
-        value 0 vanishes from every gather/scatter product."""
-        n = self.num_rows
-        counts = np.diff(self.indptr)
-        k_raw = max(int(counts.max()) if n else 1, 1)
-        k = ((k_raw + nnz_pad_multiple - 1) // nnz_pad_multiple) * nnz_pad_multiple
-        indices = np.zeros((n, k), dtype=np.int32)
-        values = np.zeros((n, k), dtype=dtype)
-        rows = np.repeat(np.arange(n), counts)
-        slots = np.arange(int(self.indptr[-1])) - np.repeat(
-            self.indptr[:-1], counts
+        densifying (see ``data.dataset.csr_to_ell``)."""
+        from photon_tpu.data.dataset import csr_to_ell
+
+        return csr_to_ell(
+            self.indptr,
+            self.indices,
+            self.values,
+            dtype=dtype,
+            nnz_pad_multiple=nnz_pad_multiple,
         )
-        indices[rows, slots] = self.indices
-        values[rows, slots] = self.values
-        return indices, values
 
     @staticmethod
     def from_dense(x: np.ndarray) -> "CSRMatrix":

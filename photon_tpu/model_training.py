@@ -71,9 +71,15 @@ def train_glm_grid(
         if use_sparse and num_features is None:
             raise ValueError("num_features is required with a SparseBatch")
         d = num_features if use_sparse else batch.num_features
+        # coefficients inherit the pre-built batch's dtype (a float64 batch
+        # must not silently solve in float32)
+        dtype = batch.values.dtype if use_sparse else batch.features.dtype
     else:
         use_sparse = choose_sparse(
-            data.num_samples, data.num_features, len(data.values)
+            data.num_samples,
+            data.num_features,
+            len(data.values),
+            itemsize=jnp.dtype(dtype).itemsize,
         )
         batch = (
             to_device_sparse_batch(data, dtype=dtype)

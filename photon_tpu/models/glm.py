@@ -33,6 +33,15 @@ class GeneralizedLinearModel:
         z = self.coefficients.compute_score(features)
         return z if offsets is None else z + offsets
 
+    def compute_margin_batch(self, batch) -> Array:
+        """Margins for either batch layout (dense ``LabeledBatch`` or
+        sparse-ELL ``SparseBatch``), offsets included."""
+        from photon_tpu.ops.objective import matvec
+
+        import jax.numpy as jnp
+
+        return matvec(batch, jnp.asarray(self.coefficients.means)) + batch.offsets
+
     def compute_mean(self, margins: Array) -> Array:
         """Inverse link applied to margins; identity by default."""
         return margins

@@ -60,9 +60,6 @@ class SparseBatch(NamedTuple):
         return self.indices.shape[-1]
 
 
-#: Either batch kind; every objective/optimizer code path accepts both.
-Batch = "LabeledBatch | SparseBatch"
-
 # Reference: photon-lib/.../Types.scala
 UniqueSampleId = int
 CoordinateId = str

@@ -194,15 +194,22 @@ def test_feature_importance_ranks_dominant_feature():
     ds = DataSet.from_dense(x, np.zeros(500))
     batch = to_device_batch(ds)
     coefs = np.array([0.01, 5.0, 0.1, 0.0])
-    rep = importance_from_batch(
-        coefs, batch.features, batch.weights, num_samples=500, top_k=4
-    )
+    rep = importance_from_batch(coefs, batch, num_samples=500, top_k=4)
     assert rep.ranked[0].index == 1
     assert rep.cumulative_share[-1] == pytest.approx(1.0)
     assert all(
         a <= b + 1e-12
         for a, b in zip(rep.cumulative_share, rep.cumulative_share[1:])
     )
+    # sparse batch produces the same ranking and moments
+    from photon_tpu.data.dataset import to_device_sparse_batch
+
+    sb = to_device_sparse_batch(ds, dtype=batch.features.dtype)
+    rep_sparse = importance_from_batch(coefs, sb, num_samples=500, top_k=4)
+    for a, b in zip(rep.ranked, rep_sparse.ranked):
+        assert a.index == b.index
+        assert a.expected_magnitude == pytest.approx(b.expected_magnitude)
+        assert a.variance_importance == pytest.approx(b.variance_importance)
 
 
 # ---------------------------------------------------------------- bootstrap
