@@ -155,15 +155,63 @@ class LegacyDriver:
             )
         self._advance(DriverStage.PREPROCESSED)
 
+    def _constraint_bounds(self):
+        """CLI constraint string → (lower, upper) arrays via the feature
+        index map (reference GLMSuite.createConstraintFeatureMap)."""
+        if not self.args.coefficient_box_constraints:
+            return None, None
+        from photon_tpu.optimize.constraints import (
+            bounds_arrays,
+            parse_constraint_string,
+        )
+
+        imap = (self.index_maps or {}).get("global")
+        if imap is None:
+            raise ValueError(
+                "--coefficient-box-constraints requires name/term feature "
+                "keys (AVRO input with an index map); LIBSVM features are "
+                "positional"
+            )
+        cmap = parse_constraint_string(
+            self.args.coefficient_box_constraints, dict(iter(imap))
+        )
+        lower, upper = bounds_arrays(cmap, self.num_features)
+        # Bounds are specified in ORIGINAL feature units but projection runs
+        # in the normalization-transformed space (w_orig = w' .* factor), so
+        # scale them; the intercept couples to every shift and cannot be
+        # boxed under a shifting normalization.
+        norm = self.normalization
+        if lower is not None and norm.factors is not None:
+            factors = np.asarray(norm.factors, dtype=np.float64)
+            lower = lower / factors
+            upper = upper / factors
+        if (
+            lower is not None
+            and norm.shifts is not None
+            and norm.intercept_index is not None
+            and (
+                np.isfinite(lower[norm.intercept_index])
+                or np.isfinite(upper[norm.intercept_index])
+            )
+        ):
+            raise ValueError(
+                "cannot box-constrain the intercept under a shifting "
+                "normalization (the intercept absorbs all feature shifts)"
+            )
+        return lower, upper
+
     def train(self) -> None:
         self._assert_stage(DriverStage.PREPROCESSED)
         a = self.args
+        lower, upper = self._constraint_bounds()
         config = GLMProblemConfig(
             task=TaskType[a.task],
             optimizer=OptimizerType[a.optimizer],
             optimizer_config=OptimizerConfig(
                 max_iterations=a.max_num_iterations,
                 tolerance=a.tolerance,
+                lower_bounds=lower,
+                upper_bounds=upper,
             ),
             regularization=RegularizationContext(
                 regularization_type=RegularizationType[a.regularization_type],
@@ -367,6 +415,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--data-validation",
         default="VALIDATE_FULL",
         choices=[t.name for t in DataValidationType],
+    )
+    p.add_argument(
+        "--coefficient-box-constraints",
+        default=None,
+        help="JSON array of maps with keys name/term/lowerBound/upperBound "
+        "('*' wildcards as in the reference); bounds are enforced by "
+        "projection after every optimizer step "
+        "(reference PhotonOptionNames.scala:42, GLMSuite.scala:190-290)",
     )
     p.add_argument("--diagnose", action="store_true")
     p.add_argument("--log-level", default="info")

@@ -5,20 +5,121 @@ scores by an id tag, apply a LocalEvaluator per group, average the
 per-group results unweighted), AreaUnderROCCurveLocalEvaluator.scala:25,
 PrecisionAtKMultiEvaluator.scala:31.
 
-Implementation note: groups are variable-sized, so this runs as a sorted
-sweep on host numpy (one argsort + segment boundaries) rather than on
-device — evaluation is off the training hot path. Per-group metrics use the
-same math as the device evaluators.
+Implementation: the built-in metrics (AUC, precision@k, RMSE) run as ONE
+device program over ALL groups — a lexsort by (group, score) followed by
+segment reductions — so per-query evaluation over 10⁸ samples costs a sort
+plus O(n) scatter-adds instead of a Python loop over groups (VERDICT r2
+weak #5; SURVEY §7 step 6 "segment-sorted device reductions"). Custom
+``group_fn`` evaluators keep the host sorted-sweep fallback.
 """
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Callable
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.evaluation.evaluators import EvaluatorType
 from photon_tpu.ops.losses import POSITIVE_RESPONSE_THRESHOLD
+
+
+# ---------------------------------------------------------------------------
+# Device kernels: one lexsort + segment reductions over all groups at once
+# ---------------------------------------------------------------------------
+
+
+def _group_starts(g_sorted, num_groups: int):
+    pos = jnp.arange(g_sorted.shape[0])
+    starts = jax.ops.segment_min(pos, g_sorted, num_segments=num_groups)
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(pos), g_sorted, num_segments=num_groups
+    )
+    return starts, counts
+
+
+@partial(jax.jit, static_argnames=("num_groups",))
+def grouped_auc_device(scores, labels, group_idx, num_groups: int):
+    """Per-group rank-statistic AUC with tie averaging, averaged unweighted
+    over groups with both classes present (single-class groups skipped, as
+    the reference's local evaluator filter does)."""
+    n = scores.shape[0]
+    order = jnp.lexsort((scores, group_idx))
+    g = group_idx[order]
+    s = scores[order]
+    pos_lbl = (labels[order] > POSITIVE_RESPONSE_THRESHOLD).astype(s.dtype)
+
+    starts, counts = _group_starts(g, num_groups)
+    idx = jnp.arange(n)
+    # runs of tied (group, score): average the ranks across each run
+    run_start = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (g[1:] != g[:-1]) | (s[1:] != s[:-1]),
+        ]
+    )
+    run_id = jnp.cumsum(run_start) - 1
+    run_first = jax.ops.segment_min(idx, run_id, num_segments=n)[run_id]
+    run_count = jax.ops.segment_sum(
+        jnp.ones_like(idx), run_id, num_segments=n
+    )[run_id]
+    # subtract the group start while still in exact integers — converting
+    # global positions to float32 first would corrupt ranks past 2^24 rows
+    run_first_within = run_first - starts[g]
+    rank = (
+        run_first_within.astype(s.dtype)
+        + (run_count - 1).astype(s.dtype) / 2.0
+        + 1.0
+    )  # 1-based within-group average rank
+
+    p = jax.ops.segment_sum(pos_lbl, g, num_segments=num_groups)
+    cnt = counts.astype(s.dtype)
+    neg = cnt - p
+    sum_pos_ranks = jax.ops.segment_sum(
+        rank * pos_lbl, g, num_segments=num_groups
+    )
+    valid = (p > 0) & (neg > 0)
+    denom = jnp.where(valid, p * neg, 1.0)
+    auc = (sum_pos_ranks - p * (p + 1) / 2.0) / denom
+    n_valid = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(jnp.where(valid, auc, 0.0)) / n_valid, jnp.sum(valid)
+
+
+@partial(jax.jit, static_argnames=("k", "num_groups"))
+def grouped_precision_at_k_device(
+    scores, labels, group_idx, k: int, num_groups: int
+):
+    """Per-group precision@k (top-k by score; groups smaller than k use
+    their full size as the denominator), averaged over non-empty groups."""
+    order = jnp.lexsort((-scores, group_idx))
+    g = group_idx[order]
+    pos_lbl = (labels[order] > POSITIVE_RESPONSE_THRESHOLD).astype(
+        scores.dtype
+    )
+    starts, counts = _group_starts(g, num_groups)
+    within = jnp.arange(scores.shape[0]) - starts[g]
+    take = (within < k).astype(scores.dtype)
+    hits = jax.ops.segment_sum(pos_lbl * take, g, num_segments=num_groups)
+    denom = jnp.minimum(counts, k).astype(scores.dtype)
+    valid = counts > 0
+    prec = hits / jnp.where(valid, denom, 1.0)
+    n_valid = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(jnp.where(valid, prec, 0.0)) / n_valid, jnp.sum(valid)
+
+
+@partial(jax.jit, static_argnames=("num_groups",))
+def grouped_rmse_device(scores, labels, group_idx, num_groups: int):
+    err2 = jnp.square(scores - labels)
+    sums = jax.ops.segment_sum(err2, group_idx, num_segments=num_groups)
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(err2), group_idx, num_segments=num_groups
+    )
+    valid = counts > 0
+    rmse = jnp.sqrt(sums / jnp.where(valid, counts, 1.0))
+    n_valid = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(jnp.where(valid, rmse, 0.0)) / n_valid, jnp.sum(valid)
 
 
 def _auc_np(scores: np.ndarray, labels: np.ndarray) -> float | None:
@@ -58,28 +159,41 @@ def _rmse_np(scores, labels):
 class MultiEvaluator:
     """Per-group evaluation averaged over groups.
 
-    ``group_fn`` maps (scores, labels) of one group to a metric or None
-    (group skipped, e.g. single-class AUC groups — reference filters these
-    out before averaging).
+    The built-in constructors set ``device_kind`` and evaluate ALL groups in
+    one jit program; custom ``group_fn`` evaluators run the host sorted
+    sweep. ``group_fn`` maps (scores, labels) of one group to a metric or
+    None (group skipped, e.g. single-class AUC groups — reference filters
+    these out before averaging).
     """
 
     group_fn: Callable[[np.ndarray, np.ndarray], float | None]
     name: str = "multi"
+    #: ("auc", 0) | ("p@k", k) | ("rmse", 0) | None (host fallback)
+    device_kind: tuple[str, int] | None = None
 
     @staticmethod
     def auc(id_tag: str = "") -> "MultiEvaluator":
-        return MultiEvaluator(_auc_np, name=f"AUC@{id_tag}" if id_tag else "AUC")
+        return MultiEvaluator(
+            _auc_np,
+            name=f"AUC@{id_tag}" if id_tag else "AUC",
+            device_kind=("auc", 0),
+        )
 
     @staticmethod
     def precision_at_k(k: int, id_tag: str = "") -> "MultiEvaluator":
         return MultiEvaluator(
             _precision_at_k(k),
             name=f"PRECISION@{k}:{id_tag}" if id_tag else f"PRECISION@{k}",
+            device_kind=("p@k", k),
         )
 
     @staticmethod
     def rmse(id_tag: str = "") -> "MultiEvaluator":
-        return MultiEvaluator(_rmse_np, name=f"RMSE@{id_tag}" if id_tag else "RMSE")
+        return MultiEvaluator(
+            _rmse_np,
+            name=f"RMSE@{id_tag}" if id_tag else "RMSE",
+            device_kind=("rmse", 0),
+        )
 
     def __call__(
         self,
@@ -90,6 +204,24 @@ class MultiEvaluator:
         scores = np.asarray(scores)
         labels = np.asarray(labels)
         group_ids = np.asarray(group_ids)
+        if self.device_kind is not None and len(scores):
+            # factorize arbitrary (e.g. string) ids to dense codes host-side;
+            # everything after is one device program
+            _, codes = np.unique(group_ids, return_inverse=True)
+            num_groups = int(codes.max()) + 1
+            s = jnp.asarray(scores, jnp.float32)
+            y = jnp.asarray(labels, jnp.float32)
+            c = jnp.asarray(codes, jnp.int32)
+            kind, k = self.device_kind
+            if kind == "auc":
+                value, n_valid = grouped_auc_device(s, y, c, num_groups)
+            elif kind == "p@k":
+                value, n_valid = grouped_precision_at_k_device(
+                    s, y, c, k, num_groups
+                )
+            else:
+                value, n_valid = grouped_rmse_device(s, y, c, num_groups)
+            return float(value) if int(n_valid) > 0 else float("nan")
         order = np.argsort(group_ids, kind="stable")
         gs = group_ids[order]
         boundaries = np.flatnonzero(np.r_[True, gs[1:] != gs[:-1], True])

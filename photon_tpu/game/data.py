@@ -346,6 +346,7 @@ def build_random_effect_dataset(
     seed: int = 0,
     intercept_col: int | None = None,
     entity_shards: int = 1,
+    existing_model_keys=None,
 ) -> RandomEffectDataset:
     """Group samples by entity, apply bounds/sampling/projection, bucket.
 
@@ -411,6 +412,13 @@ def build_random_effect_dataset(
         num_passive <= config.passive_data_lower_bound
     )
     entity_kept = counts >= config.active_data_lower_bound
+    if existing_model_keys is not None:
+        # ignoreThresholdForNewModels: entities WITHOUT a prior model bypass
+        # the lower bound; entities with one must still meet it (reference
+        # RandomEffectDataSet.generateActiveData:
+        # `size >= lowerBound || !existingKeys.contains(key)`).
+        has_prior = np.isin(vocab, np.asarray(list(existing_model_keys)))
+        entity_kept = entity_kept | ~has_prior
     keep_sorted = entity_kept[ent_sorted] & (
         active_sorted | ~drop_passive[ent_sorted]
     )
@@ -628,26 +636,6 @@ def _concat_ranges(starts: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     # at each range boundary, jump from the previous range's last value
     out[ends_nz[:-1]] = starts_nz[1:] - (starts_nz[:-1] + lengths_nz[:-1] - 1)
     return np.cumsum(out)
-
-
-def balanced_entity_assignment(
-    counts: np.ndarray, num_shards: int, heavy_top_k: int = 10000
-) -> np.ndarray:
-    """Greedy bin-packing of the heaviest entities + hashing for the rest
-    (reference RandomEffectDataSetPartitioner.scala:113-147). Returns a
-    shard id per entity — used to split buckets across the mesh entity axis.
-    """
-    assignment = np.empty(len(counts), dtype=np.int32)
-    order = np.argsort(-counts)
-    heavy = order[: min(heavy_top_k, len(order))]
-    light = order[min(heavy_top_k, len(order)) :]
-    load = np.zeros(num_shards, dtype=np.int64)
-    for e in heavy:
-        s = int(np.argmin(load))
-        assignment[e] = s
-        load[s] += counts[e]
-    assignment[light] = light % num_shards
-    return assignment
 
 
 def labels_are_binary(labels: np.ndarray) -> bool:

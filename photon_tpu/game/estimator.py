@@ -36,12 +36,29 @@ from photon_tpu.game.coordinate import (
 )
 from photon_tpu.game.data import GameData, build_random_effect_dataset
 from photon_tpu.game.descent import run_coordinate_descent
-from photon_tpu.game.model import GameModel
+from photon_tpu.game.model import (
+    GameModel,
+    RandomEffectModel,
+    merge_random_effect_carryover,
+)
 from photon_tpu.game.transformer import GameTransformer
 from photon_tpu.ops.normalization import NormalizationContext
 from photon_tpu.types import TaskType
 
 logger = logging.getLogger(__name__)
+
+
+def _carry_over_prior_models(model: GameModel, initial: GameModel) -> GameModel:
+    """Warm-start survival of prior per-entity models with no new data
+    (reference RandomEffectCoordinate.updateModel leftOuterJoin branch)."""
+    merged = dict(model.coordinates)
+    for cid, new_cm in model.coordinates.items():
+        prior_cm = initial.coordinates.get(cid)
+        if isinstance(new_cm, RandomEffectModel) and isinstance(
+            prior_cm, RandomEffectModel
+        ):
+            merged[cid] = merge_random_effect_carryover(new_cm, prior_cm)
+    return dataclasses.replace(model, coordinates=merged)
 
 
 @dataclasses.dataclass
@@ -69,6 +86,11 @@ class GameEstimator:
     descent_iterations: int = 1
     normalization_contexts: Mapping[str, NormalizationContext] | None = None
     locked_coordinates: frozenset = frozenset()
+    #: warm-start semantics for the RE lower bound: entities WITHOUT a prior
+    #: model bypass ``active_data_lower_bound`` (reference
+    #: GameEstimator.ignoreThresholdForNewModels :127-133 →
+    #: RandomEffectDataSet.generateActiveData). Requires ``initial_model``.
+    ignore_threshold_for_new_models: bool = False
     validation_evaluator: EvaluatorType | None = None
     #: (data, entity) device mesh; when set, fixed-effect batches shard
     #: rows over the whole mesh (gradient psums over ICI) and random-effect
@@ -89,7 +111,7 @@ class GameEstimator:
 
     # ------------------------------------------------------------------
 
-    def _build_coordinates(self, data: GameData):
+    def _build_coordinates(self, data: GameData, initial_model=None):
         coords = {}
         re_datasets = {}
         norm = self.normalization_contexts or {}
@@ -109,8 +131,22 @@ class GameEstimator:
                     from photon_tpu.parallel.mesh import ENTITY_AXIS
 
                     entity_shards = dict(self.mesh.shape).get(ENTITY_AXIS, 1)
+                existing_keys = None
+                if self.ignore_threshold_for_new_models and initial_model is not None:
+                    # coordinate absent from the prior model → every entity
+                    # is "new" and bypasses the bound (empty key set)
+                    prior = initial_model.coordinates.get(cid)
+                    existing_keys = (
+                        prior.modeled_keys()
+                        if isinstance(prior, RandomEffectModel)
+                        else set()
+                    )
                 ds = build_random_effect_dataset(
-                    data, cfg, seed=self.seed, entity_shards=entity_shards
+                    data,
+                    cfg,
+                    seed=self.seed,
+                    entity_shards=entity_shards,
+                    existing_model_keys=existing_keys,
                 )
                 re_datasets[cid] = ds
                 coords[cid] = RandomEffectCoordinate.build(
@@ -151,11 +187,16 @@ class GameEstimator:
     ) -> list[GameTrainingResult]:
         """Train one GameModel per λ-grid point, warm-starting across the
         grid (reference fit :304-390 + train :746)."""
+        if self.ignore_threshold_for_new_models and initial_model is None:
+            raise ValueError(
+                "ignore_threshold_for_new_models requires an initial model "
+                "(reference GameEstimator validation :226)"
+            )
         if self.mesh is not None:
             from photon_tpu.game.data import pad_game_data
 
             data = pad_game_data(data, int(self.mesh.devices.size))
-        coordinates, re_datasets = self._build_coordinates(data)
+        coordinates, re_datasets = self._build_coordinates(data, initial_model)
 
         init_states = None
         if initial_model is not None:
@@ -205,6 +246,8 @@ class GameEstimator:
                 cd.best_states if cd.best_states is not None else cd.states
             )
             model = self._to_model(coords_gi, final_states)
+            if initial_model is not None:
+                model = _carry_over_prior_models(model, initial_model)
             results.append(
                 GameTrainingResult(
                     model=model,

@@ -355,6 +355,10 @@ def load_game_model(
         for cdir in sorted(re_dir.iterdir()):
             if not cdir.is_dir():
                 continue
+            if not (cdir / COEFFICIENTS).is_dir():
+                # JVM artifacts may carry id-info-only coordinate dirs (e.g.
+                # coordinates never retrained in the producing job) — skip
+                continue
             lines = (cdir / ID_INFO).read_text().strip().splitlines()
             re_type, shard = lines[0], lines[1]
             imap = index_maps[shard]
@@ -549,6 +553,8 @@ def read_model_feature_keys(
                     "projection; scoring it requires the training-time "
                     "feature index (--off-heap-index-map-dir)"
                 )
+            if not (cdir / COEFFICIENTS).is_dir():
+                continue  # id-info-only coordinate (see load_game_model)
             lines = (cdir / ID_INFO).read_text().strip().splitlines()
             shard = lines[0] if section == FIXED_EFFECT else lines[1]
             bucket = keys.setdefault(shard, set())

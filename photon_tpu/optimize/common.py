@@ -107,11 +107,12 @@ def project_to_box(
 ) -> Array:
     """Clamp coefficients into box constraints (reference
     OptimizationUtils.projectCoefficientsToSubspace, applied after every
-    optimizer step, LBFGS.scala:72)."""
+    optimizer step, LBFGS.scala:72). Bounds are cast to the coefficient
+    dtype so float64 bound arrays never promote a float32 solve."""
     if lower is not None:
-        x = jnp.maximum(x, lower)
+        x = jnp.maximum(x, jnp.asarray(lower, dtype=x.dtype))
     if upper is not None:
-        x = jnp.minimum(x, upper)
+        x = jnp.minimum(x, jnp.asarray(upper, dtype=x.dtype))
     return x
 
 

@@ -22,6 +22,7 @@ from photon_tpu.optimize.common import (
     OptimizeResult,
     OptimizerConfig,
     convergence_check,
+    project_to_box,
 )
 from photon_tpu.optimize.lbfgs import _CURVATURE_EPS, two_loop_direction
 from photon_tpu.types import Array
@@ -67,6 +68,9 @@ def minimize_owlqn(
     m = config.num_corrections
     t = config.max_iterations
     l1 = jnp.asarray(l1_weight, dtype)
+    has_box = config.lower_bounds is not None or config.upper_bounds is not None
+    if has_box:
+        x0 = project_to_box(x0, config.lower_bounds, config.upper_bounds)
 
     def eval_smooth(x):
         f, g = value_and_grad(x)
@@ -167,6 +171,16 @@ def minimize_owlqn(
                 jnp.zeros((), bool),
             ),
         )
+        if has_box:
+            # box projection after every step, like the reference OWLQN
+            # (constraintMap flows through the LBFGS base, LBFGS.scala:59-82)
+            x_proj = project_to_box(
+                x_new, config.lower_bounds, config.upper_bounds
+            )
+            f_s, g_new = eval_smooth(x_proj)
+            f_new = full_value(f_s, x_proj)
+            x_new = x_proj
+            ls_iters = ls_iters + 1
 
         # History update with smooth gradients.
         s_vec = x_new - s.x

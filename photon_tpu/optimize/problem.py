@@ -177,7 +177,17 @@ class GLMProblem:
         if has_l1 or opt == OptimizerType.OWLQN:
             return minimize_owlqn(vg, w0, objective.l1_weight, cfg)
         if opt == OptimizerType.TRON:
-            if cfg == OptimizerConfig():
+            # fully untouched config → switch to TRON's own defaults
+            # (field-wise check excluding the bounds, which may be arrays —
+            # dataclass == would hit numpy's ambiguous-truth error; a config
+            # with bounds set is customized, so no swap either way)
+            d = OptimizerConfig()
+            untouched = cfg.lower_bounds is None and cfg.upper_bounds is None and all(
+                getattr(cfg, f.name) == getattr(d, f.name)
+                for f in dataclasses.fields(OptimizerConfig)
+                if f.name not in ("lower_bounds", "upper_bounds")
+            )
+            if untouched:
                 cfg = cfg.tron_defaults()
             return minimize_tron(
                 vg,

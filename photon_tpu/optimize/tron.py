@@ -24,6 +24,7 @@ from photon_tpu.optimize.common import (
     OptimizeResult,
     OptimizerConfig,
     convergence_check,
+    project_to_box,
 )
 from photon_tpu.types import Array
 
@@ -150,6 +151,9 @@ def minimize_tron(
         config = OptimizerConfig().tron_defaults()
     dtype = x0.dtype
     t = config.max_iterations
+    has_box = config.lower_bounds is not None or config.upper_bounds is not None
+    if has_box:
+        x0 = project_to_box(x0, config.lower_bounds, config.upper_bounds)
 
     def eval_at(x):
         f, g = value_and_grad(x)
@@ -190,7 +194,14 @@ def minimize_tron(
         gs = jnp.dot(s.g, step)
         prered = -0.5 * (gs - jnp.dot(step, r))
 
-        f_new, g_new = eval_at(s.x + step)
+        x_cand = s.x + step
+        if has_box:
+            # project into the box after the optimization step (reference
+            # TRON.scala:226-228) and evaluate at the projected point
+            x_cand = project_to_box(
+                x_cand, config.lower_bounds, config.upper_bounds
+            )
+        f_new, g_new = eval_at(x_cand)
         actred = s.f - f_new
 
         # Radius update (TRON.scala:152-251 / LIBLINEAR tron.cpp).
@@ -215,7 +226,7 @@ def minimize_tron(
         )
 
         accept = actred > _ETA0 * prered
-        x_out = jnp.where(accept, s.x + step, s.x)
+        x_out = jnp.where(accept, x_cand, s.x)
         f_out = jnp.where(accept, f_new, s.f)
         g_out = jnp.where(accept, g_new, s.g)
 

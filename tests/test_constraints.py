@@ -1,0 +1,245 @@
+"""Box-constraint tests: JSON constraint parsing (reference GLMSuite
+semantics, io/deprecated/GLMSuite.scala:190-290) and per-step projection in
+the optimizers (OptimizationUtils.projectCoefficientsToSubspace,
+LBFGS.scala:59-82)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.index_map import INTERCEPT_KEY, feature_key
+from photon_tpu.ops.losses import SquaredLoss
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optimize import OptimizerConfig, minimize_lbfgs
+from photon_tpu.optimize.constraints import (
+    bounds_arrays,
+    parse_constraint_string,
+)
+from photon_tpu.types import LabeledBatch
+
+KEYS = {
+    feature_key("age", ""): 0,
+    feature_key("age", "4"): 1,
+    feature_key("age", "12"): 2,
+    feature_key("clicks", "7"): 3,
+    INTERCEPT_KEY: 4,
+}
+
+
+def test_explicit_and_default_bounds():
+    cmap = parse_constraint_string(
+        '[{"name": "age", "term": "", "lowerBound": -1, "upperBound": 0},'
+        ' {"name": "age", "term": "4", "lowerBound": -1},'
+        ' {"name": "clicks", "term": "7", "upperBound": 0.5}]',
+        KEYS,
+    )
+    assert cmap == {
+        0: (-1.0, 0.0),
+        1: (-1.0, float("inf")),
+        3: (float("-inf"), 0.5),
+    }
+    lower, upper = bounds_arrays(cmap, 5)
+    np.testing.assert_array_equal(lower, [-1, -1, -np.inf, -np.inf, -np.inf])
+    np.testing.assert_array_equal(upper, [0, np.inf, np.inf, 0.5, np.inf])
+
+
+def test_term_wildcard_spans_all_terms_of_name():
+    cmap = parse_constraint_string(
+        '[{"name": "age", "term": "*", "lowerBound": -2, "upperBound": 2}]',
+        KEYS,
+    )
+    assert set(cmap) == {0, 1, 2}
+
+
+def test_all_wildcard_excludes_intercept_and_must_be_alone():
+    cmap = parse_constraint_string(
+        '[{"name": "*", "term": "*", "lowerBound": -1, "upperBound": 1}]',
+        KEYS,
+    )
+    assert set(cmap) == {0, 1, 2, 3}  # intercept (index 4) exempt
+    with pytest.raises(ValueError, match="cannot be combined"):
+        parse_constraint_string(
+            '[{"name": "age", "term": "", "lowerBound": 0},'
+            ' {"name": "*", "term": "*", "upperBound": 1}]',
+            KEYS,
+        )
+
+
+@pytest.mark.parametrize(
+    "bad,msg",
+    [
+        ('[{"term": "x", "lowerBound": 0}]', "name"),
+        ('[{"name": "age", "term": ""}]', "finite"),
+        (
+            '[{"name": "age", "term": "", "lowerBound": 2, "upperBound": 1}]',
+            "less than",
+        ),
+        ('[{"name": "*", "term": "t", "lowerBound": 0}]', "wildcard"),
+        ("not json", "JSON"),
+        (
+            '[{"name": "age", "term": "4", "lowerBound": 0},'
+            ' {"name": "age", "term": "*", "upperBound": 3}]',
+            "conflicting",
+        ),
+    ],
+)
+def test_rejects_malformed_constraints(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        parse_constraint_string(bad, KEYS)
+
+
+def test_constrained_solve_projects_every_step():
+    """Unconstrained optimum has w* ≈ [2, -3]; the box forces w into
+    [0,1]x[-1,0] and the solution must sit on the active boundary."""
+    rng = np.random.default_rng(0)
+    n, d = 256, 2
+    x = rng.normal(size=(n, d))
+    w_star = np.array([2.0, -3.0])
+    y = x @ w_star + 0.01 * rng.normal(size=n)
+    batch = LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros(n),
+        weights=jnp.ones(n),
+    )
+    obj = GLMObjective(loss=SquaredLoss)
+    cfg = OptimizerConfig(
+        max_iterations=50,
+        lower_bounds=jnp.asarray([0.0, -1.0]),
+        upper_bounds=jnp.asarray([1.0, 0.0]),
+    )
+    res = minimize_lbfgs(lambda w: obj.value_and_gradient(w, batch), jnp.zeros(d), cfg)
+    w = np.asarray(res.x)
+    assert 0.0 <= w[0] <= 1.0 and -1.0 <= w[1] <= 0.0
+    # clamped at the boundary nearest the unconstrained optimum
+    np.testing.assert_allclose(w, [1.0, -1.0], atol=1e-6)
+
+
+def test_constrained_tron_and_owlqn_project():
+    """Reference projects in every optimizer family: TRON after each TR step
+    (TRON.scala:226-228), OWLQN through the LBFGS base (LBFGS.scala:59-82)."""
+    from photon_tpu.optimize import minimize_owlqn, minimize_tron
+
+    rng = np.random.default_rng(3)
+    n, d = 256, 2
+    x = rng.normal(size=(n, d))
+    y = x @ np.array([2.0, -3.0]) + 0.01 * rng.normal(size=n)
+    batch = LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros(n),
+        weights=jnp.ones(n),
+    )
+    obj = GLMObjective(loss=SquaredLoss)
+    cfg = OptimizerConfig(
+        max_iterations=40,
+        lower_bounds=jnp.asarray([0.0, -1.0]),
+        upper_bounds=jnp.asarray([1.0, 0.0]),
+    )
+    res_t = minimize_tron(
+        lambda w: obj.value_and_gradient(w, batch),
+        lambda w, v: obj.hessian_vector(w, v, batch),
+        jnp.zeros(d),
+        cfg,
+    )
+    np.testing.assert_allclose(np.asarray(res_t.x), [1.0, -1.0], atol=1e-5)
+    res_o = minimize_owlqn(
+        lambda w: obj.value_and_gradient(w, batch), jnp.zeros(d), 0.01, cfg
+    )
+    w = np.asarray(res_o.x)
+    assert 0.0 <= w[0] <= 1.0 and -1.0 <= w[1] <= 0.0
+    np.testing.assert_allclose(w, [1.0, -1.0], atol=1e-3)
+
+
+def test_bounds_scale_with_normalization_factors():
+    """Bounds are given in original units; under factor normalization the
+    trained ORIGINAL-space coefficient must respect them."""
+    from photon_tpu.data.dataset import DataSet
+    from photon_tpu.model_training import train_glm_grid
+    from photon_tpu.ops.normalization import NormalizationContext
+    from photon_tpu.optimize.problem import GLMProblemConfig
+    from photon_tpu.types import NormalizationType, OptimizerType, TaskType
+
+    rng = np.random.default_rng(4)
+    n, d = 512, 2
+    x = rng.normal(size=(n, d)) * np.array([0.01, 10.0])  # wild scales
+    y = x @ np.array([50.0, -0.2]) + 0.01 * rng.normal(size=n)
+    ds = DataSet.from_dense(x, y)
+    ctx = NormalizationContext.build(
+        NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+        mean=x.mean(axis=0),
+        variance=x.var(axis=0),
+        dtype=jnp.float64,
+    )
+    # transform bounds the way the legacy driver does
+    factors = np.asarray(ctx.factors, dtype=np.float64)
+    lower = np.array([-1.0, -1.0]) / factors
+    upper = np.array([1.0, 1.0]) / factors
+    cfg = GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(
+            max_iterations=60, lower_bounds=lower, upper_bounds=upper
+        ),
+    )
+    [tm] = train_glm_grid(ds, cfg, [0.0], normalization=ctx, dtype=jnp.float64)
+    w = np.asarray(tm.model.coefficients.means)
+    assert np.all(w >= -1.0 - 1e-6) and np.all(w <= 1.0 + 1e-6)
+    assert w[0] == pytest.approx(1.0, abs=1e-4)  # clamped in original units
+
+
+def test_legacy_driver_constraint_flag(tmp_path):
+    """CLI → constraint map → bounds: train a tiny Avro dataset with a box
+    on one named feature and assert the trained coefficient respects it."""
+    from photon_tpu.cli import legacy_driver
+    from photon_tpu.io.avro import write_avro_file
+    from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+    rng = np.random.default_rng(1)
+    n = 400
+    f1 = rng.normal(size=n)
+    f2 = rng.normal(size=n)
+    y = 3.0 * f1 - 2.0 * f2 + 0.05 * rng.normal(size=n)
+    rows = [
+        {
+            "uid": str(i),
+            "label": float(y[i]),
+            "features": [
+                {"name": "f1", "term": "", "value": float(f1[i])},
+                {"name": "f2", "term": "", "value": float(f2[i])},
+            ],
+            "weight": 1.0,
+            "offset": 0.0,
+            "metadataMap": {},
+        }
+        for i in range(n)
+    ]
+    data_dir = tmp_path / "train"
+    data_dir.mkdir()
+    write_avro_file(
+        data_dir / "part-00000.avro", TRAINING_EXAMPLE_AVRO, rows
+    )
+    path = data_dir
+    out = tmp_path / "out"
+    drv = legacy_driver.run(
+        [
+            "--training-data-directory",
+            str(path),
+            "--output-directory",
+            str(out),
+            "--task",
+            "LINEAR_REGRESSION",
+            "--regularization-type",
+            "NONE",
+            "--regularization-weights",
+            "0",
+            "--coefficient-box-constraints",
+            '[{"name": "f1", "term": "", "lowerBound": -1, "upperBound": 1}]',
+        ]
+    )
+    [tm] = drv.models
+    imap = drv.index_maps["global"]
+    w = np.asarray(tm.model.coefficients.means)
+    i1 = imap.get_index(feature_key("f1", ""))
+    i2 = imap.get_index(feature_key("f2", ""))
+    assert w[i1] == pytest.approx(1.0, abs=1e-5)  # clamped at the box
+    assert w[i2] == pytest.approx(-2.0, abs=0.1)  # unconstrained

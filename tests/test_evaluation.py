@@ -88,3 +88,69 @@ def test_precision_at_k():
     v = MultiEvaluator.precision_at_k(2)(scores, labels, groups)
     # group a top2: [0.9→1, 0.8→0] = 0.5 ; group b top2: [0.95→1, 0.3→1] = 1.0
     np.testing.assert_allclose(v, 0.75)
+
+
+# ------------------------------------------------------- device grouped path
+
+
+def _host_evaluator(dev_eval):
+    """Same evaluator forced onto the host sorted-sweep fallback."""
+    import dataclasses as _dc
+
+    return _dc.replace(dev_eval, device_kind=None)
+
+
+def test_grouped_device_matches_host_loop():
+    """The one-program segment-sorted kernels must agree with the per-group
+    host loop on skewed groups WITH score ties and single-class groups."""
+    from photon_tpu.evaluation.multi import MultiEvaluator
+
+    rng = np.random.default_rng(0)
+    n, n_groups = 5000, 130
+    groups = np.array([f"q{g}" for g in rng.integers(0, n_groups, size=n)])
+    # quantized scores force plenty of ties
+    scores = np.round(rng.normal(size=n), 1)
+    labels = (rng.uniform(size=n) < 0.3).astype(np.float64)
+    # make a few groups single-class (skipped by AUC)
+    labels[groups == "q0"] = 1.0
+    labels[groups == "q1"] = 0.0
+
+    for make in (MultiEvaluator.auc, MultiEvaluator.rmse):
+        ev = make()
+        host = _host_evaluator(ev)(scores, labels, groups)
+        dev = ev(scores, labels, groups)
+        np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+    # precision@k is tie-ORDER-dependent (host argsort and device lexsort
+    # may break ties differently), so compare it on unique scores
+    uniq_scores = scores + rng.uniform(0, 1e-4, size=n)
+    ev = MultiEvaluator.precision_at_k(5)
+    np.testing.assert_allclose(
+        ev(uniq_scores, labels, groups),
+        _host_evaluator(ev)(uniq_scores, labels, groups),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_grouped_device_k_larger_than_group():
+    from photon_tpu.evaluation.multi import MultiEvaluator
+
+    scores = np.array([0.9, 0.1, 0.5])
+    labels = np.array([1.0, 0.0, 1.0])
+    groups = np.array(["a", "a", "b"])
+    ev = MultiEvaluator.precision_at_k(10)
+    # a: 1/2 positives in top-10(=2); b: 1/1
+    np.testing.assert_allclose(ev(scores, labels, groups), 0.75)
+    np.testing.assert_allclose(
+        _host_evaluator(ev)(scores, labels, groups), 0.75
+    )
+
+
+def test_grouped_device_all_single_class_is_nan():
+    from photon_tpu.evaluation.multi import MultiEvaluator
+
+    scores = np.array([0.9, 0.1])
+    labels = np.array([1.0, 1.0])
+    groups = np.array(["a", "a"])
+    assert np.isnan(MultiEvaluator.auc()(scores, labels, groups))

@@ -1,0 +1,128 @@
+"""Warm-start semantics tests: prior-model carryover and
+ignoreThresholdForNewModels (reference GameEstimator.scala:127-133,
+RandomEffectCoordinate.scala:113-127, RandomEffectDataSet.generateActiveData).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import GLMProblemConfig
+from photon_tpu.types import TaskType
+
+
+def _game_data(user_counts: dict, seed=0, d_fixed=4, d_re=3):
+    """Synthetic logistic GameData with exactly ``user_counts[u]`` samples
+    per user."""
+    rng = np.random.default_rng(seed)
+    uids = [u for u, c in user_counts.items() for _ in range(c)]
+    n = len(uids)
+    x_fe = rng.normal(size=(n, d_fixed))
+    x_re = rng.normal(size=(n, d_re))
+    y = (rng.uniform(size=n) > 0.5).astype(np.float64)
+    return GameData.build(
+        labels=y,
+        feature_shards={
+            "global": CSRMatrix.from_dense(x_fe),
+            "per_user": CSRMatrix.from_dense(x_re),
+        },
+        id_tags={"userId": uids},
+    )
+
+
+def _estimator(lower_bound=1, ignore_threshold=False):
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=5, ls_max_iterations=5),
+    )
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="global",
+                optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+            "per-user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="per_user",
+                optimization=opt,
+                regularization_weights=(1.0,),
+                active_data_lower_bound=lower_bound,
+            ),
+        },
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=1,
+        ignore_threshold_for_new_models=ignore_threshold,
+        dtype=jnp.float64,
+    )
+
+
+def _modeled_users(model):
+    re = model.coordinates["per-user"]
+    return {re.vocab[e] for b in re.buckets for e in b.entity_ids}
+
+
+def test_ignore_threshold_requires_initial_model():
+    data = _game_data({"a": 4})
+    with pytest.raises(ValueError, match="initial model"):
+        _estimator(ignore_threshold=True).fit(data)
+
+
+def test_ignore_threshold_exempts_new_entities_only():
+    # Round 1: users a (5 samples) and b (4) clear the bound and get models.
+    prior = _estimator(lower_bound=3).fit(_game_data({"a": 5, "b": 4}))[0].model
+    assert _modeled_users(prior) == {"a", "b"}
+
+    # Round 2 data: a stays above the bound, b falls below it, c is new and
+    # below it. With the flag: c (no prior model) bypasses the bound and is
+    # trained; b (has a prior model) is NOT retrained; b's prior model
+    # carries over into the output.
+    data2 = _game_data({"a": 4, "b": 2, "c": 2}, seed=1)
+    [res] = _estimator(lower_bound=3, ignore_threshold=True).fit(
+        data2, initial_model=prior
+    )
+    assert _modeled_users(res.model) == {"a", "b", "c"}
+
+    prior_b = prior.coordinates["per-user"].entity_model("b")
+    out_b = res.model.coordinates["per-user"].entity_model("b")
+    np.testing.assert_allclose(
+        np.asarray(out_b.coefficients.means),
+        np.asarray(prior_b.coefficients.means),
+    )
+    # a was retrained on new data — its model must differ from the prior
+    prior_a = prior.coordinates["per-user"].entity_model("a")
+    out_a = res.model.coordinates["per-user"].entity_model("a")
+    assert not np.allclose(
+        np.asarray(out_a.coefficients.means),
+        np.asarray(prior_a.coefficients.means),
+    )
+
+    # Without the flag, both b and c fall below the bound: c gets no model,
+    # b survives only through carryover.
+    [res2] = _estimator(lower_bound=3).fit(data2, initial_model=prior)
+    assert _modeled_users(res2.model) == {"a", "b"}
+
+
+def test_carryover_preserves_prior_entities_without_new_data():
+    prior = _estimator().fit(_game_data({"a": 4, "b": 3}))[0].model
+    # b absent from the new data entirely
+    [res] = _estimator().fit(_game_data({"a": 4, "c": 3}, seed=2),
+                             initial_model=prior)
+    assert _modeled_users(res.model) == {"a", "b", "c"}
+    prior_b = prior.coordinates["per-user"].entity_model("b")
+    out_b = res.model.coordinates["per-user"].entity_model("b")
+    np.testing.assert_allclose(
+        np.asarray(out_b.coefficients.means),
+        np.asarray(prior_b.coefficients.means),
+    )
+    # carried-over model scores through the cold path
+    score_data = _game_data({"b": 2}, seed=3)
+    scores = res.model.coordinates["per-user"].score_cold(score_data)
+    assert np.any(scores != 0)
