@@ -206,11 +206,14 @@ class MultiEvaluator:
         group_ids = np.asarray(group_ids)
         if self.device_kind is not None and len(scores):
             # factorize arbitrary (e.g. string) ids to dense codes host-side;
-            # everything after is one device program
+            # everything after is one device program. Input dtype is
+            # preserved (under x64, float64 scores keep their tie structure);
+            # ranks are computed within-group, so precision holds for any
+            # group below 2^24 rows even in float32.
             _, codes = np.unique(group_ids, return_inverse=True)
             num_groups = int(codes.max()) + 1
-            s = jnp.asarray(scores, jnp.float32)
-            y = jnp.asarray(labels, jnp.float32)
+            s = jnp.asarray(scores)
+            y = jnp.asarray(labels, s.dtype)
             c = jnp.asarray(codes, jnp.int32)
             kind, k = self.device_kind
             if kind == "auc":
