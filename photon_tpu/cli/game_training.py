@@ -107,6 +107,25 @@ def build_parser() -> argparse.ArgumentParser:
         choices=[m.name for m in HyperparameterTuningMode],
     )
     p.add_argument("--hyper-parameter-tuning-iter", type=int, default=10)
+    p.add_argument(
+        "--hyper-parameter-prior-json",
+        default=None,
+        help="path to serialized prior observations from earlier jobs "
+        "(reference HyperparameterSerialization format: {'records': [...]})",
+    )
+    p.add_argument(
+        "--hyper-parameter-shrink-radius",
+        type=float,
+        default=None,
+        help="contract the search box to ±radius (in [0,1] space) around "
+        "the GP-predicted best prior point (reference ShrinkSearchRange)",
+    )
+    p.add_argument(
+        "--hyper-parameter-save-observations",
+        default=None,
+        help="write this run's (weights, evaluation) observations as prior "
+        "JSON for future jobs",
+    )
     p.add_argument("--compute-variance", action="store_true")
     p.add_argument("--model-sparsity-threshold", type=float, default=1e-4)
     p.add_argument(
@@ -315,6 +334,10 @@ def run(argv=None) -> dict:
                 raise ValueError(
                     "hyperparameter tuning requires validation data + an evaluator"
                 )
+            prior_json = None
+            if args.hyper_parameter_prior_json:
+                with open(args.hyper_parameter_prior_json) as f:
+                    prior_json = f.read()
             with Timed("hyperparameter tuning"):
                 tuned = run_hyperparameter_tuning(
                     estimator,
@@ -322,8 +345,22 @@ def run(argv=None) -> dict:
                     validation_data,
                     num_iterations=args.hyper_parameter_tuning_iter,
                     mode=tuning_mode.name,
+                    prior_json=prior_json,
+                    shrink_radius=args.hyper_parameter_shrink_radius,
                 )
             results = results + tuned
+        if args.hyper_parameter_save_observations:
+            # written for the plain λ-sweep too (mode NONE) — every model
+            # with a validation evaluation is a usable prior
+            from photon_tpu.hyperparameter.serialization import priors_to_json
+
+            obs = [
+                (r.regularization_weights, float(r.evaluation))
+                for r in results
+                if r.evaluation is not None
+            ]
+            with open(args.hyper_parameter_save_observations, "w") as f:
+                f.write(priors_to_json(obs))
         emitter.emit("training_finish", num_models=len(results))
 
         best = _select_best(results, validation_evaluator)

@@ -213,6 +213,8 @@ class MultiEvaluator:
             _, codes = np.unique(group_ids, return_inverse=True)
             num_groups = int(codes.max()) + 1
             s = jnp.asarray(scores)
+            if not jnp.issubdtype(s.dtype, jnp.floating):
+                s = s.astype(jnp.float32)
             y = jnp.asarray(labels, s.dtype)
             c = jnp.asarray(codes, jnp.int32)
             kind, k = self.device_kind

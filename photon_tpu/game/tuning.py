@@ -122,14 +122,64 @@ def run_hyperparameter_tuning(
     mode: str = "BAYESIAN",
     reg_ranges: Mapping[str, tuple[float, float]] | None = None,
     prior_observations: Sequence[tuple[np.ndarray, float]] = (),
+    prior_json: str | None = None,
+    shrink_radius: float | None = None,
     seed: int = 0,
 ) -> list[GameTrainingResult]:
     """Bayesian or random search over regularization weights (reference
-    GameTrainingDriver.runHyperparameterTuning :631-668)."""
+    GameTrainingDriver.runHyperparameterTuning :631-668).
+
+    ``prior_json`` carries serialized observations from earlier jobs
+    (reference HyperparameterSerialization.priorFromJson); with
+    ``shrink_radius`` set, the search box first contracts around the
+    GP-predicted best prior region (reference ShrinkSearchRange.getBounds).
+    """
     fn = GameEstimatorEvaluationFunction(
         estimator, train_data, validation_data, reg_ranges
     )
     maximize = estimator.validation_evaluator.larger_is_better
+    prior_observations = list(prior_observations)
+    if prior_json is not None:
+        from photon_tpu.hyperparameter.serialization import (
+            priors_from_json,
+            shrink_search_range,
+        )
+
+        defaults = {
+            cid: float(
+                estimator.coordinate_configs[cid].regularization_weights[0]
+            )
+            for cid in fn.tunable
+        }
+        parsed = priors_from_json(prior_json, fn.tunable, defaults)
+        if shrink_radius is not None and parsed:
+            pts01 = np.stack(
+                [fn.weights_to_candidate(p) for p, _ in parsed]
+            )
+            vals = np.array([v for _, v in parsed])
+            lo01, hi01 = shrink_search_range(
+                pts01,
+                vals,
+                radius=shrink_radius,
+                maximize=maximize,
+                seed=seed,
+            )
+            lo = rescale_backward(lo01, fn.ranges)
+            hi = rescale_backward(hi01, fn.ranges)
+            new_ranges = {
+                cid: (float(lo[i]), float(hi[i]))
+                for i, cid in enumerate(fn.tunable)
+            }
+            fn = GameEstimatorEvaluationFunction(
+                estimator, train_data, validation_data, new_ranges
+            )
+        for params, value in parsed:
+            cand = fn.weights_to_candidate(params)
+            # priors outside the (possibly shrunk) box are DROPPED — clipping
+            # them onto the boundary would attribute their evaluations to
+            # points where they were never measured
+            if np.all((cand >= 0.0) & (cand <= 1.0)):
+                prior_observations.append((cand, float(value)))
     if mode.upper() == "BAYESIAN":
         search: RandomSearch = GaussianProcessSearch(
             fn.num_params, fn, seed=seed, maximize=maximize

@@ -37,14 +37,26 @@ from photon_tpu.types import Array, LabeledBatch, SparseBatch
 def matvec(batch, v: Array) -> Array:
     """X·v for either batch layout.
 
-    Dense: one MXU matmul. Sparse ELL: gather the K coefficient slots per row
-    and row-sum — padding slots hold value 0 so they vanish. This (plus
-    ``rmatvec``) is how the sparse path preserves the reference aggregator's
-    never-densify property (ValueAndGradientAggregator.scala:36-80) on TPU.
+    Dense: one MXU matmul. When the feature block is stored bfloat16, the
+    coefficient operand is cast down but the MXU accumulates in float32
+    (``preferred_element_type``) — halved HBM traffic and doubled MXU rate
+    at full-precision accumulation; optimizer state stays float32. Sparse
+    ELL: gather the K coefficient slots per row and row-sum — padding slots
+    hold value 0 so they vanish. This (plus ``rmatvec``) is how the sparse
+    path preserves the reference aggregator's never-densify property
+    (ValueAndGradientAggregator.scala:36-80) on TPU.
     """
     if isinstance(batch, SparseBatch):
         return jnp.sum(v[batch.indices] * batch.values, axis=-1)
-    return batch.features @ v
+    x = batch.features
+    if x.dtype == jnp.bfloat16:
+        return jax.lax.dot_general(
+            x,
+            v.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return x @ v
 
 
 def rmatvec(batch, per_row: Array, dim: int) -> Array:
@@ -60,7 +72,15 @@ def rmatvec(batch, per_row: Array, dim: int) -> Array:
         return jax.ops.segment_sum(
             flat, batch.indices.reshape(-1), num_segments=dim
         )
-    return batch.features.T @ per_row
+    x = batch.features
+    if x.dtype == jnp.bfloat16:
+        return jax.lax.dot_general(
+            x,
+            per_row.astype(jnp.bfloat16),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return x.T @ per_row
 
 
 @dataclasses.dataclass(frozen=True)

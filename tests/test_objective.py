@@ -120,3 +120,36 @@ def test_coefficient_space_roundtrip():
     np.testing.assert_allclose(
         ctx.model_to_transformed_space(w_orig), w_t, rtol=1e-9, atol=1e-12
     )
+
+
+def test_bf16_feature_block_matches_f32_within_tolerance():
+    """bfloat16 feature storage with f32 MXU accumulation: margins/gradient/
+    Hv close to the f32 path at bf16 resolution; outputs stay f32."""
+    rng = np.random.default_rng(11)
+    n, d = 128, 32
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    f32 = LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros(n, jnp.float32),
+        weights=jnp.ones(n, jnp.float32),
+    )
+    bf16 = f32._replace(features=jnp.asarray(x, jnp.bfloat16))
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.1)
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.1)
+    v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+    v32, g32 = obj.value_and_gradient(w, f32)
+    v16, g16 = obj.value_and_gradient(w, bf16)
+    assert g16.dtype == jnp.float32
+    np.testing.assert_allclose(float(v16), float(v32), rtol=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(g16), np.asarray(g32), rtol=0.1, atol=0.1
+    )
+    h16 = obj.hessian_vector(w, v, bf16)
+    h32 = obj.hessian_vector(w, v, f32)
+    assert h16.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(h16), np.asarray(h32), rtol=0.1, atol=0.1
+    )

@@ -55,8 +55,16 @@ def _re_config(ub=None, max_iter=3):
 
 def test_re_dataset_build_at_1e6_entities():
     """The vectorized build must handle 10⁶ skewed entities in host memory
-    and reasonable wall time, with a budgeted device footprint."""
-    num_entities, n = 1_000_000, 2_000_000
+    and reasonable wall time, with a budgeted device footprint.
+
+    PHOTON_SCALE_ENTITIES scales the shape down for constrained CI runners
+    (shared GitHub runners have ~7 GB RAM); the full 10⁶ default runs in
+    the development environment and is the scale demonstration of record.
+    """
+    import os
+
+    num_entities = int(os.environ.get("PHOTON_SCALE_ENTITIES", 1_000_000))
+    n = 2 * num_entities
     data = _skewed_game_data(num_entities, n, d_re=8)
     t0 = time.perf_counter()
     ds = build_random_effect_dataset(data, _re_config(ub=256), seed=0)
@@ -68,7 +76,7 @@ def test_re_dataset_build_at_1e6_entities():
     # the bucketed blocks must stay within a small fraction of one chip's
     # HBM (16 GiB) for this shape, and padding below 60%
     assert budget["total_bytes"] < 4 << 30, budget
-    assert budget["coefficient_count"] >= 1_000_000
+    assert budget["coefficient_count"] >= num_entities
     assert waste["total_waste"] < 0.6, waste
     # all samples placed exactly once across buckets
     placed = sum(

@@ -89,3 +89,64 @@ def test_tuning_loop(mode):
     assert all(np.isfinite(e) for e in evals)
     # low regularization should fit this clean linear problem well
     assert min(evals) < 0.5
+
+
+# ---------------------------------------------- prior serialization / shrink
+
+
+def test_priors_json_roundtrip():
+    from photon_tpu.hyperparameter.serialization import (
+        priors_from_json,
+        priors_to_json,
+    )
+
+    obs = [({"fixed": 0.5, "per-user": 10.0}, 0.81), ({"fixed": 2.0}, 0.75)]
+    js = priors_to_json(obs)
+    parsed = priors_from_json(
+        js, ["fixed", "per-user"], defaults={"per-user": 1.0}
+    )
+    assert parsed[0] == ({"fixed": 0.5, "per-user": 10.0}, 0.81)
+    # record 2 lacked per-user → default filled in
+    assert parsed[1] == ({"fixed": 2.0, "per-user": 1.0}, 0.75)
+    with pytest.raises(ValueError, match="default"):
+        priors_from_json(js, ["fixed", "per-user"])
+    with pytest.raises(ValueError, match="records"):
+        priors_from_json("{}", ["fixed"])
+
+
+def test_shrink_search_range_contracts_around_best_prior():
+    from photon_tpu.hyperparameter.serialization import shrink_search_range
+
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(size=(30, 2))
+    # quadratic bowl peaked at (0.7, 0.3)
+    vals = -((pts[:, 0] - 0.7) ** 2 + (pts[:, 1] - 0.3) ** 2)
+    lo, hi = shrink_search_range(pts, vals, radius=0.1, maximize=True, seed=1)
+    assert np.all(hi - lo <= 0.2 + 1e-9)
+    assert lo[0] <= 0.7 <= hi[0] + 0.1
+    assert lo[1] - 0.1 <= 0.3 <= hi[1] + 0.1
+
+
+def test_tuning_with_prior_json_and_shrink():
+    from photon_tpu.hyperparameter.serialization import priors_to_json
+
+    est, data = _tiny_problem()
+    prior = priors_to_json(
+        [({"fixed": 0.1}, 0.35), ({"fixed": 100.0}, 2.5), ({"fixed": 0.2}, 0.36)]
+    )
+    tuned = run_hyperparameter_tuning(
+        est,
+        data,
+        data,
+        num_iterations=2,
+        mode="BAYESIAN",
+        prior_json=prior,
+        shrink_radius=0.15,
+        seed=0,
+    )
+    assert len(tuned) == 2
+    for r in tuned:
+        assert r.evaluation is not None
+        # shrink box sits around the good small-λ priors (RMSE minimized),
+        # far from λ=100
+        assert list(r.regularization_weights.values())[0] < 50.0
