@@ -41,7 +41,6 @@ from photon_tpu.game.model import (
     RandomEffectModel,
     merge_random_effect_carryover,
 )
-from photon_tpu.game.transformer import GameTransformer
 from photon_tpu.ops.normalization import NormalizationContext
 from photon_tpu.types import TaskType
 
@@ -206,14 +205,18 @@ class GameEstimator:
 
         validation_fn = None
         if validation_data is not None and self.validation_evaluator is not None:
-            evaluator = self.validation_evaluator
+            # built once; per-sweep evaluation is device gathers/einsums over
+            # the live optimizer states — no GameModel/transformer rebuild
+            # per sweep (r2 weak #6)
+            from photon_tpu.game.validation import DeviceValidationScorer
 
-            def validation_fn_impl(states):
-                model = self._to_model(coordinates, states)
-                transformer = GameTransformer(model=model, task=self.task)
-                return transformer.evaluate(validation_data, evaluator)
-
-            validation_fn = validation_fn_impl
+            scorer = DeviceValidationScorer.build(
+                validation_data,
+                coordinates,
+                self.validation_evaluator,
+                self.dtype,
+            )
+            validation_fn = scorer.evaluate
 
         results = []
         states = init_states

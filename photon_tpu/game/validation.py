@@ -1,0 +1,262 @@
+"""Per-sweep validation scoring directly from device coordinate states.
+
+Round 2 flagged (weak #6) that every coordinate-descent sweep rebuilt a full
+GameModel on host (numpy copies of every random-effect bucket) plus a
+GameTransformer just to compute one validation metric — fine at test scale,
+pathological at 10⁶ entities. This module builds the validation scoring
+STRUCTURE once (projected feature blocks, entity→(bucket, slot) maps, all
+device-resident) and then evaluates each sweep as pure device gathers and
+einsums over the CURRENT optimizer states — no host round-trip, no model
+materialization.
+
+Numerics match the transformer path exactly: fixed effects score through
+the same effective-coefficient/margin-shift algebra as
+FixedEffectCoordinate.score; random effects reproduce
+RandomEffectModel.score_cold (columns outside an entity's compacted space
+and entities without a model contribute zero).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.evaluation.evaluators import EvaluatorType, evaluate
+from photon_tpu.game.coordinate import (
+    FixedEffectCoordinate,
+    MatrixFactorizationCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_tpu.game.data import GameData, entity_row_indices
+from photon_tpu.ops.objective import matvec
+from photon_tpu.types import Array
+
+
+@dataclasses.dataclass(eq=False)
+class _FixedEffectValScorer:
+    #: the training coordinate re-pointed at the validation batch — reusing
+    #: FixedEffectCoordinate.score keeps train/validation scoring algebra
+    #: from ever drifting apart
+    coordinate: FixedEffectCoordinate
+
+    def __call__(self, state: Array) -> Array:
+        return self.coordinate.score(state)
+
+
+@dataclasses.dataclass(eq=False)
+class _REBucketValBlock:
+    rows: Array  # [m] validation row indices
+    slots: Array  # [m] entity slot within the bucket state
+    x_proj: Array  # [m, d_bucket] features in the entity's projected space
+
+
+@dataclasses.dataclass(eq=False)
+class _RandomEffectValScorer:
+    blocks: list  # per bucket: _REBucketValBlock | None
+    num_rows: int
+    dtype: object
+
+    def __call__(self, state: list[Array]) -> Array:
+        out = jnp.zeros((self.num_rows,), self.dtype)
+        for blk, coefs in zip(self.blocks, state):
+            if blk is None:
+                continue
+            c = coefs[blk.slots]
+            s = jnp.einsum("md,md->m", blk.x_proj, c.astype(self.dtype))
+            out = out.at[blk.rows].add(s)
+        return out
+
+
+@dataclasses.dataclass(eq=False)
+class _MFValScorer:
+    row_idx: Array  # [n] into u (num_rows ⇒ unseen)
+    col_idx: Array  # [n] into v
+
+    def __call__(self, state) -> Array:
+        u, v = state
+        u_pad = jnp.concatenate([u, jnp.zeros((1, u.shape[1]), u.dtype)])
+        v_pad = jnp.concatenate([v, jnp.zeros((1, v.shape[1]), v.dtype)])
+        return jnp.einsum(
+            "nk,nk->n", u_pad[self.row_idx], v_pad[self.col_idx]
+        )
+
+
+def _build_re_scorer(
+    coord: RandomEffectCoordinate, data: GameData, dtype
+) -> _RandomEffectValScorer:
+    ds = coord.dataset
+    n = data.num_samples
+    keys = np.asarray(data.id_tags[ds.random_effect_type])
+    shard = data.feature_shards[ds.feature_shard]
+
+    # entity dense index per validation row (-1 = unmodeled/unseen)
+    oov = len(ds.vocab)
+    ent_of_row = entity_row_indices(ds.entity_index, keys, oov)
+
+    # entity → (bucket, slot)
+    bucket_of = np.full(oov + 1, -1, dtype=np.int64)
+    slot_of = np.zeros(oov + 1, dtype=np.int64)
+    for bi, b in enumerate(ds.buckets):
+        bucket_of[b.entity_ids] = bi
+        slot_of[b.entity_ids] = np.arange(len(b.entity_ids))
+    row_bucket = bucket_of[ent_of_row]  # -1 for unmodeled entities
+
+    # nonzeros of all validation rows
+    counts = np.diff(shard.indptr)
+    nnz_row = np.repeat(np.arange(n), counts)
+    nnz_col = shard.indices.astype(np.int64)
+    nnz_val = shard.values
+
+    blocks: list = []
+    for bi, b in enumerate(ds.buckets):
+        in_b = np.flatnonzero(row_bucket == bi)
+        if len(in_b) == 0:
+            blocks.append(None)
+            continue
+        m = len(in_b)
+        d_max = b.col_index.shape[1]
+        local_row = np.full(n, -1, dtype=np.int64)
+        local_row[in_b] = np.arange(m)
+        sel = local_row[nnz_row] >= 0
+        r_sel = local_row[nnz_row[sel]]
+        c_sel = nnz_col[sel]
+        v_sel = nnz_val[sel]
+        host_dtype = np.dtype(dtype)
+        x_proj = np.zeros((m, d_max), dtype=host_dtype)
+        if ds.projection_matrix is not None:
+            k = ds.projection_matrix.shape[1]
+            np.add.at(
+                x_proj[:, :k],
+                r_sel,
+                (v_sel[:, None] * ds.projection_matrix[c_sel]).astype(
+                    host_dtype
+                ),
+            )
+        else:
+            # map global column → the entity's local (compacted) column via
+            # one searchsorted over (entity, col) pairs: col_index rows are
+            # ascending with -1 padding at the tail
+            e_sel = ent_of_row[in_b][r_sel]
+            slot_sel = slot_of[e_sel]
+            cols_b = b.col_index.astype(np.int64)  # [E, d_max]
+            d_e = (cols_b >= 0).sum(axis=1)
+            big = np.int64(ds.num_features) + 1
+            # flat sorted model keys: entity-slot-major, valid cols only
+            valid = cols_b >= 0
+            flat_keys = (
+                np.repeat(np.arange(cols_b.shape[0]), d_e) * big
+                + cols_b[valid]
+            )
+            flat_local = _concat_aranges(d_e)
+            probe = slot_sel * big + c_sel
+            if len(flat_keys):
+                pos = np.minimum(
+                    np.searchsorted(flat_keys, probe), len(flat_keys) - 1
+                )
+                match = flat_keys[pos] == probe
+                x_proj[r_sel[match], flat_local[pos[match]]] = v_sel[
+                    match
+                ].astype(host_dtype)
+        blocks.append(
+            _REBucketValBlock(
+                rows=jnp.asarray(in_b, jnp.int32),
+                slots=jnp.asarray(slot_of[ent_of_row[in_b]], jnp.int32),
+                x_proj=jnp.asarray(x_proj),
+            )
+        )
+    return _RandomEffectValScorer(blocks=blocks, num_rows=n, dtype=dtype)
+
+
+def _concat_aranges(lengths: np.ndarray) -> np.ndarray:
+    total = int(lengths.sum())
+    out = np.arange(total)
+    starts = np.concatenate(([0], np.cumsum(lengths)[:-1]))
+    return out - np.repeat(starts, lengths)
+
+
+@dataclasses.dataclass(eq=False)
+class DeviceValidationScorer:
+    """Built once per fit; ``evaluate(states)`` is all-device per sweep."""
+
+    scorers: dict
+    labels: Array
+    weights: Array
+    offsets: Array
+    evaluator: EvaluatorType
+
+    @staticmethod
+    def build(
+        validation_data: GameData,
+        coordinates: dict,
+        evaluator: EvaluatorType,
+        dtype=jnp.float32,
+    ) -> "DeviceValidationScorer":
+        scorers: dict = {}
+        for cid, coord in coordinates.items():
+            if isinstance(coord, FixedEffectCoordinate):
+                from photon_tpu.game.coordinate import _use_sparse
+                from photon_tpu.types import LabeledBatch, SparseBatch
+
+                shard = validation_data.feature_shards[coord.feature_shard]
+                nv = validation_data.num_samples
+                zeros = jnp.zeros((nv,), dtype)
+                ones = jnp.ones((nv,), dtype)
+                if _use_sparse(coord.config.representation, shard, dtype):
+                    idx, val = shard.to_ell(dtype=np.dtype(dtype))
+                    batch = SparseBatch(
+                        indices=jnp.asarray(idx),
+                        values=jnp.asarray(val, dtype),
+                        labels=zeros,
+                        offsets=zeros,
+                        weights=ones,
+                    )
+                else:
+                    batch = LabeledBatch(
+                        features=jnp.asarray(shard.to_dense(dtype), dtype),
+                        labels=zeros,
+                        offsets=zeros,
+                        weights=ones,
+                    )
+                scorers[cid] = _FixedEffectValScorer(
+                    dataclasses.replace(coord, batch=batch)
+                )
+            elif isinstance(coord, RandomEffectCoordinate):
+                scorers[cid] = _build_re_scorer(coord, validation_data, dtype)
+            elif isinstance(coord, MatrixFactorizationCoordinate):
+                row_index = {k: i for i, k in enumerate(coord.row_vocab)}
+                col_index = {k: i for i, k in enumerate(coord.col_vocab)}
+                ri = entity_row_indices(
+                    row_index,
+                    validation_data.id_tags[coord.config.row_entity_type],
+                    len(row_index),
+                )
+                ci = entity_row_indices(
+                    col_index,
+                    validation_data.id_tags[coord.config.col_entity_type],
+                    len(col_index),
+                )
+                scorers[cid] = _MFValScorer(
+                    row_idx=jnp.asarray(ri, jnp.int32),
+                    col_idx=jnp.asarray(ci, jnp.int32),
+                )
+            else:
+                raise TypeError(f"no validation scorer for {type(coord)}")
+        return DeviceValidationScorer(
+            scorers=scorers,
+            labels=jnp.asarray(validation_data.labels, dtype),
+            weights=jnp.asarray(validation_data.weights, dtype),
+            offsets=jnp.asarray(validation_data.offsets, dtype),
+            evaluator=evaluator,
+        )
+
+    def margins(self, states: dict) -> Array:
+        total = self.offsets
+        for cid, scorer in self.scorers.items():
+            total = total + scorer(states[cid]).astype(total.dtype)
+        return total
+
+    def evaluate(self, states: dict) -> float:
+        m = self.margins(states)
+        return float(evaluate(self.evaluator, m, self.labels, self.weights))

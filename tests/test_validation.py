@@ -1,0 +1,132 @@
+"""Device validation scorer parity: per-sweep validation computed from live
+device states must match the model-materializing transformer path
+(estimator r2 weak #6 fix)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.evaluation.evaluators import EvaluatorType
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    ProjectorType,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator
+from photon_tpu.game.transformer import GameTransformer
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import GLMProblemConfig
+from photon_tpu.types import TaskType
+
+
+def _data(seed, n, n_users, d_fe=6, d_re=5, user_pool=None):
+    rng = np.random.default_rng(seed)
+    x_fe = rng.normal(size=(n, d_fe))
+    # sparse-ish RE features so per-entity index compaction actually compacts
+    x_re = rng.normal(size=(n, d_re)) * (rng.uniform(size=(n, d_re)) < 0.6)
+    users = rng.integers(0, n_users, size=n)
+    pool = user_pool or "u"
+    y = (rng.uniform(size=n) > 0.5).astype(np.float64)
+    return GameData.build(
+        labels=y,
+        offsets=rng.normal(scale=0.1, size=n),
+        weights=rng.uniform(0.5, 2.0, size=n),
+        feature_shards={
+            "global": CSRMatrix.from_dense(x_fe),
+            "per_user": CSRMatrix.from_dense(x_re),
+        },
+        id_tags={"userId": [f"{pool}{u}" for u in users]},
+    )
+
+
+@pytest.mark.parametrize(
+    "projector", [ProjectorType.INDEX_MAP, ProjectorType.RANDOM]
+)
+def test_device_validation_matches_transformer(projector):
+    train = _data(0, 300, 12)
+    # validation includes users unseen at training time (pool v overlaps u
+    # only partially via distinct keys)
+    valid = _data(1, 150, 20)
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=5, ls_max_iterations=5),
+    )
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="global",
+                optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+            "per-user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="per_user",
+                optimization=opt,
+                regularization_weights=(1.0,),
+                projector_type=projector,
+                random_projection_dim=4,
+            ),
+        },
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=2,
+        validation_evaluator=EvaluatorType.AUC,
+        dtype=jnp.float64,
+    )
+    [res] = est.fit(train, validation_data=valid)
+    assert res.evaluation is not None
+    # the tracker's per-sweep metric comes from the device scorer; the
+    # transformer recomputes the same metric from the materialized model
+    transformer = GameTransformer(model=res.model, task=est.task)
+    via_model = transformer.evaluate(valid, EvaluatorType.AUC)
+    np.testing.assert_allclose(res.evaluation, via_model, rtol=1e-6)
+
+
+def test_device_validation_matches_transformer_with_mf():
+    from photon_tpu.game.config import MatrixFactorizationCoordinateConfig
+
+    rng = np.random.default_rng(2)
+    n = 240
+    x_fe = rng.normal(size=(n, 5))
+
+    def build(seed, n_items=9):
+        r = np.random.default_rng(seed)
+        users = r.integers(0, 10, size=n)
+        items = r.integers(0, n_items, size=n)  # val pool has unseen items
+        return GameData.build(
+            labels=(r.uniform(size=n) > 0.5).astype(np.float64),
+            feature_shards={"global": CSRMatrix.from_dense(x_fe)},
+            id_tags={
+                "userId": [f"u{u}" for u in users],
+                "itemId": [f"i{i}" for i in items],
+            },
+        )
+
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=4, ls_max_iterations=5),
+    )
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="global",
+                optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+            "mf": MatrixFactorizationCoordinateConfig(
+                row_entity_type="userId",
+                col_entity_type="itemId",
+                optimization=opt,
+                num_factors=3,
+            ),
+        },
+        update_sequence=["fixed", "mf"],
+        descent_iterations=2,
+        validation_evaluator=EvaluatorType.LOGISTIC_LOSS,
+        dtype=jnp.float64,
+    )
+    [res] = est.fit(build(0), validation_data=build(1, n_items=12))
+    transformer = GameTransformer(model=res.model, task=est.task)
+    via_model = transformer.evaluate(build(1, n_items=12), EvaluatorType.LOGISTIC_LOSS)
+    np.testing.assert_allclose(res.evaluation, via_model, rtol=1e-6)
