@@ -766,10 +766,23 @@ def run_orchestrator() -> int:
     any_ok = False
     for name, timeout_s, attempts in CONFIG_PLAN:
         ok = False
-        for attempt in range(attempts):
+        # last attempt falls back to CPU when the TPU attempts failed — a
+        # labeled CPU number beats an empty slot (each config's output
+        # records the backend it actually ran on)
+        plans = [env] * attempts
+        if env.get("JAX_PLATFORMS", "") != "cpu":
+            plans = plans + [dict(env, JAX_PLATFORMS="cpu")]
+        for attempt, attempt_env in enumerate(plans):
+            cpu_note = (
+                " [CPU fallback]"
+                if attempt_env.get("JAX_PLATFORMS") == "cpu"
+                and env.get("JAX_PLATFORMS", "") != "cpu"
+                else ""
+            )
             _log(
                 f"[bench] === config {name} attempt "
-                f"{attempt + 1}/{attempts} (timeout {timeout_s}s) ==="
+                f"{attempt + 1}/{len(plans)}{cpu_note} "
+                f"(timeout {timeout_s}s) ==="
             )
             t0 = time.perf_counter()
             try:
@@ -779,7 +792,7 @@ def run_orchestrator() -> int:
                     capture_output=True,
                     text=True,
                     timeout=timeout_s,
-                    env=env,
+                    env=attempt_env,
                 )
                 sys.stderr.write(out.stderr or "")
                 sys.stderr.flush()
@@ -808,7 +821,7 @@ def run_orchestrator() -> int:
                 err = f"timeout >{timeout_s}s (killed)"
                 _log(f"[bench] config {name} {err}")
                 results["errors"][name] = err
-            if attempt + 1 < attempts:
+            if attempt + 1 < len(plans):
                 wait = 15 * (attempt + 1)
                 _log(f"[bench] retrying {name} in {wait}s")
                 time.sleep(wait)

@@ -321,11 +321,29 @@ def run(argv=None) -> dict:
         )
 
         emitter.emit("training_start", task=task.name)
+        # flush each grid point's model as it completes (output mode ALL):
+        # a crash mid-grid keeps every finished model on disk — the
+        # checkpoint-based recovery story replacing Spark task retry
+        grid_callback = None
+        flushed = set()
+        if ModelOutputMode[args.output_mode] == ModelOutputMode.ALL:
+
+            def grid_callback(gi, result):
+                save_game_model(
+                    os.path.join(out_root, MODELS_DIR, str(gi)),
+                    result.model,
+                    index_maps,
+                    optimization_configurations=result.regularization_weights,
+                    sparsity_threshold=args.model_sparsity_threshold,
+                )
+                flushed.add(gi)
+
         with Timed("train"):
             results = estimator.fit(
                 data,
                 validation_data=validation_data,
                 initial_model=initial_model,
+                grid_callback=grid_callback,
             )
 
         tuning_mode = HyperparameterTuningMode[args.hyper_parameter_tuning]
@@ -384,6 +402,8 @@ def run(argv=None) -> dict:
             with Timed("save models"):
                 if output_mode == ModelOutputMode.ALL:
                     for i, r in enumerate(results):
+                        if i in flushed:  # already written by grid_callback
+                            continue
                         save_game_model(
                             os.path.join(out_root, MODELS_DIR, str(i)),
                             r.model,

@@ -183,9 +183,17 @@ class GameEstimator:
         *,
         validation_data: GameData | None = None,
         initial_model: GameModel | None = None,
+        grid_callback=None,
     ) -> list[GameTrainingResult]:
         """Train one GameModel per λ-grid point, warm-starting across the
-        grid (reference fit :304-390 + train :746)."""
+        grid (reference fit :304-390 + train :746).
+
+        ``grid_callback(grid_index, result)`` fires as each grid point
+        completes — drivers use it to flush partial progress to disk so a
+        crash never loses finished models (SURVEY §5.3: the reference
+        delegates recovery to Spark task retry; here checkpointing is the
+        recovery story).
+        """
         if self.ignore_threshold_for_new_models and initial_model is None:
             raise ValueError(
                 "ignore_threshold_for_new_models requires an initial model "
@@ -251,15 +259,16 @@ class GameEstimator:
             model = self._to_model(coords_gi, final_states)
             if initial_model is not None:
                 model = _carry_over_prior_models(model, initial_model)
-            results.append(
-                GameTrainingResult(
-                    model=model,
-                    evaluation=cd.best_metric,
-                    regularization_weights=reg_weights,
-                    tracker=cd.tracker,
-                    wall_time_s=time.perf_counter() - t_grid,
-                )
+            result = GameTrainingResult(
+                model=model,
+                evaluation=cd.best_metric,
+                regularization_weights=reg_weights,
+                tracker=cd.tracker,
+                wall_time_s=time.perf_counter() - t_grid,
             )
+            results.append(result)
+            if grid_callback is not None:
+                grid_callback(gi, result)
             states = cd.states  # warm start the next grid point
 
         return results

@@ -243,11 +243,17 @@ class DeviceValidationScorer:
                 )
             else:
                 raise TypeError(f"no validation scorer for {type(coord)}")
+        # metric inputs keep >= f32 precision even when the model computes
+        # in bf16 — only margins inherit the state dtype
+        eval_dtype = (
+            dtype if jnp.dtype(dtype) in (jnp.float32, jnp.float64)
+            else jnp.float32
+        )
         return DeviceValidationScorer(
             scorers=scorers,
-            labels=jnp.asarray(validation_data.labels, dtype),
-            weights=jnp.asarray(validation_data.weights, dtype),
-            offsets=jnp.asarray(validation_data.offsets, dtype),
+            labels=jnp.asarray(validation_data.labels, eval_dtype),
+            weights=jnp.asarray(validation_data.weights, eval_dtype),
+            offsets=jnp.asarray(validation_data.offsets, eval_dtype),
             evaluator=evaluator,
         )
 

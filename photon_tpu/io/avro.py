@@ -365,6 +365,18 @@ def read_avro_file(path: str | os.PathLike) -> list[dict]:
     return list(iter_avro_file(path))
 
 
+def read_schema(path: str | os.PathLike) -> dict:
+    """Writer schema from a container file's header (no record decoding)."""
+    with open(path, "rb") as f:
+        data = f.read(1 << 20)  # header metadata is tiny
+    if data[:4] != MAGIC:
+        raise ValueError(f"{path}: not an Avro object container file")
+    r = _Reader(data)
+    r.pos = 4
+    meta = _decode(r, {"type": "map", "values": "bytes"}, {})
+    return json.loads(meta["avro.schema"].decode("utf-8"))
+
+
 def read_avro_dir(path: str | os.PathLike) -> Iterator[dict]:
     """Read all ``*.avro`` part files under a directory (sorted), or a
     single file — the reference's multi-part HDFS dir convention."""

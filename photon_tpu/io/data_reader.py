@@ -10,6 +10,7 @@ coordinate build.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Iterable, Mapping, Sequence
 
 import numpy as np
@@ -95,9 +96,27 @@ class AvroDataReader:
         *,
         id_tags: Sequence[str] = (),
     ) -> GameData:
-        """Read avro files/dirs into one GameData (reference readMerged)."""
+        """Read avro files/dirs into one GameData (reference readMerged).
+
+        The C++ columnar fast path (io/native_avro.py) handles the common
+        schemas; anything it can't express falls back to the record-dict
+        decode below — both produce identical GameData.
+        """
         if isinstance(paths, (str, bytes)):
             paths = [paths]
+        if os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
+            try:
+                from photon_tpu.io.native_avro import read_game_data_native
+
+                native = read_game_data_native(
+                    list(paths), shard_configs, id_tags, dict(self.index_maps)
+                )
+            except Exception:  # any native-path surprise → Python decode
+                native = None
+            if native is not None:
+                data, maps = native
+                self.index_maps.update(maps)
+                return data
         records = []
         for p in paths:
             records.extend(read_avro_dir(p))

@@ -28,3 +28,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Sanitizer analogue (SURVEY §5.2): PHOTON_DEBUG_NANS=1 makes every NaN
+# produced inside a jit program raise at the producing op — the functional
+# counterpart of the JVM's memory-safety guarantees the reference leans on.
+if os.environ.get("PHOTON_DEBUG_NANS") == "1":
+    jax.config.update("jax_debug_nans", True)

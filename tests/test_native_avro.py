@@ -1,0 +1,271 @@
+"""Native C++ Avro decoder parity: the columnar fast path must produce a
+GameData identical to the pure-Python record-dict reader on generated
+files, multi-bag GAME files with metadataMap id tags, deflate blocks, and
+the JVM-written fixture."""
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.io.avro import write_avro_file
+from photon_tpu.io.data_reader import AvroDataReader, FeatureShardConfig
+from photon_tpu.io.native_avro import _lib, compile_program
+from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+pytestmark = pytest.mark.skipif(
+    _lib() is None, reason="native library unavailable"
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "jvm")
+
+
+def _read_both(paths, shards, id_tags=()):
+    native = AvroDataReader().read(paths, shards, id_tags=id_tags)
+    os.environ["PHOTON_NO_NATIVE_AVRO"] = "1"
+    try:
+        python = AvroDataReader().read(paths, shards, id_tags=id_tags)
+    finally:
+        del os.environ["PHOTON_NO_NATIVE_AVRO"]
+    return native, python
+
+
+def _assert_same(a, b, id_tags=()):
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.offsets, b.offsets)
+    np.testing.assert_array_equal(a.weights, b.weights)
+    assert (a.uids is None) == (b.uids is None)
+    if a.uids is not None:
+        assert list(a.uids) == list(b.uids)
+    assert set(a.feature_shards) == set(b.feature_shards)
+    for s in a.feature_shards:
+        sa, sb = a.feature_shards[s], b.feature_shards[s]
+        np.testing.assert_array_equal(sa.indptr, sb.indptr)
+        np.testing.assert_array_equal(sa.indices, sb.indices)
+        np.testing.assert_array_equal(sa.values, sb.values)
+        assert sa.num_cols == sb.num_cols
+    for t in id_tags:
+        np.testing.assert_array_equal(a.id_tags[t], b.id_tags[t])
+
+
+def _records(seed=0, n=200, nullable_weight=True):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        feats = [
+            {
+                "name": f"f{int(j)}",
+                "term": str(int(j % 3)),
+                "value": float(rng.normal()),
+            }
+            for j in rng.choice(20, size=rng.integers(1, 6), replace=False)
+        ]
+        rec = {
+            "uid": f"id{i}",
+            "label": float(rng.integers(0, 2)),
+            "features": feats,
+            "weight": 1.5 if nullable_weight and i % 3 == 0 else 1.0,
+            "offset": float(rng.normal(scale=0.1)),
+            "metadataMap": {"userId": f"u{i % 7}", "queryId": f"q{i % 5}"},
+        }
+        out.append(rec)
+    return out
+
+
+def test_program_compiles_for_training_schema():
+    assert compile_program(TRAINING_EXAMPLE_AVRO, ["features"]) is not None
+
+
+def test_parity_on_generated_training_file(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    write_avro_file(d / "part-00000.avro", TRAINING_EXAMPLE_AVRO, _records(0))
+    write_avro_file(
+        d / "part-00001.avro", TRAINING_EXAMPLE_AVRO, _records(1, n=77)
+    )
+    shards = {
+        "global": FeatureShardConfig(feature_bags=("features",)),
+        "no_intercept": FeatureShardConfig(
+            feature_bags=("features",), has_intercept=False
+        ),
+    }
+    a, b = _read_both(str(d), shards, id_tags=("userId", "queryId"))
+    _assert_same(a, b, id_tags=("userId", "queryId"))
+    assert a.num_samples == 277
+
+
+def test_parity_on_jvm_fixture():
+    shards = {
+        "global": FeatureShardConfig(
+            feature_bags=("features",), has_intercept=True
+        )
+    }
+    a, b = _read_both(
+        os.path.join(FIXTURES, "heart.avro"), shards
+    )
+    _assert_same(a, b)
+    assert a.num_samples == 250
+
+
+def test_fallback_on_unsupported_schema(tmp_path):
+    """A schema outside the fast path's coverage must silently take the
+    Python path and still read correctly (enum field → unsupported)."""
+    schema = {
+        "type": "record",
+        "name": "Weird",
+        "fields": [
+            {"name": "label", "type": "double"},
+            {
+                "name": "kind",
+                "type": {
+                    "type": "enum", "name": "K", "symbols": ["A", "B"]
+                },
+            },
+            {
+                "name": "features",
+                "type": {
+                    "type": "array",
+                    "items": {
+                        "type": "record",
+                        "name": "F",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "term", "type": "string"},
+                            {"name": "value", "type": "double"},
+                        ],
+                    },
+                },
+            },
+        ],
+    }
+    assert compile_program(schema, ["features"]) is None
+    recs = [
+        {
+            "label": 1.0,
+            "kind": "A",
+            "features": [{"name": "x", "term": "", "value": 2.0}],
+        }
+    ]
+    p = tmp_path / "weird.avro"
+    write_avro_file(p, schema, recs)
+    data = AvroDataReader().read(
+        str(p), {"g": FeatureShardConfig(feature_bags=("features",))}
+    )
+    assert data.num_samples == 1
+    assert data.labels[0] == 1.0
+
+
+def test_multi_bag_game_file(tmp_path):
+    schema = {
+        "type": "record",
+        "name": "GameRec",
+        "fields": [
+            {"name": "response", "type": "int"},
+            {"name": "uid", "type": ["null", "long"], "default": None},
+            {
+                "name": "userFeatures",
+                "type": {
+                    "type": "array",
+                    "items": {
+                        "type": "record",
+                        "name": "FeatureAvro",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "term", "type": ["null", "string"]},
+                            {"name": "value", "type": "double"},
+                        ],
+                    },
+                },
+            },
+            {"name": "songFeatures", "type": {"type": "array", "items": "FeatureAvro"}},
+            {
+                "name": "metadataMap",
+                "type": {"type": "map", "values": ["null", "string"]},
+            },
+        ],
+    }
+    rng = np.random.default_rng(3)
+    recs = []
+    for i in range(120):
+        recs.append(
+            {
+                "response": int(rng.integers(0, 2)),
+                "uid": int(i) if i % 4 else None,
+                "userFeatures": [
+                    {
+                        "name": f"u{int(j)}",
+                        "term": None if j % 2 else str(int(j)),
+                        "value": float(rng.normal()),
+                    }
+                    for j in rng.choice(8, size=2, replace=False)
+                ],
+                "songFeatures": [
+                    {"name": f"s{int(rng.integers(0, 9))}", "term": None,
+                     "value": float(rng.normal())}
+                ],
+                "metadataMap": {
+                    "songId": f"song{i % 11}",
+                    "maybe": None if i % 5 else "x",
+                },
+            }
+        )
+    p = tmp_path / "game.avro"
+    write_avro_file(p, schema, recs)
+    shards = {
+        "user": FeatureShardConfig(feature_bags=("userFeatures",)),
+        "both": FeatureShardConfig(
+            feature_bags=("userFeatures", "songFeatures")
+        ),
+    }
+    a, b = _read_both(str(p), shards, id_tags=("songId",))
+    _assert_same(a, b, id_tags=("songId",))
+
+
+def test_label_response_precedence_matches_python(tmp_path):
+    """Per-record: a present 'label' beats 'response'; a null label falls
+    back to response — regardless of schema field order."""
+    feat = {
+        "type": "array",
+        "items": {
+            "type": "record",
+            "name": "F2",
+            "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": "string"},
+                {"name": "value", "type": "double"},
+            ],
+        },
+    }
+    schema = {
+        "type": "record",
+        "name": "R2",
+        "fields": [
+            {"name": "response", "type": "double"},  # response FIRST
+            {"name": "label", "type": ["null", "double"]},
+            {"name": "features", "type": feat},
+        ],
+    }
+    recs = [
+        {"response": 0.0, "label": 1.0,
+         "features": [{"name": "x", "term": "", "value": 1.0}]},
+        {"response": 5.0, "label": None,
+         "features": [{"name": "x", "term": "", "value": 1.0}]},
+    ]
+    p = tmp_path / "lr.avro"
+    write_avro_file(p, schema, recs)
+    a, b = _read_both(
+        str(p), {"g": FeatureShardConfig(feature_bags=("features",))}
+    )
+    np.testing.assert_array_equal(a.labels, [1.0, 5.0])
+    np.testing.assert_array_equal(a.labels, b.labels)
+
+
+def test_float_uid_takes_python_path():
+    schema = {
+        "type": "record",
+        "name": "R3",
+        "fields": [
+            {"name": "uid", "type": "double"},
+            {"name": "label", "type": "double"},
+        ],
+    }
+    assert compile_program(schema, []) is None
