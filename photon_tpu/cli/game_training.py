@@ -97,6 +97,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--model-input-directory", default=None)
     p.add_argument(
+        "--ignore-threshold-for-new-models",
+        action="store_true",
+        help="warm start: entities WITHOUT a prior random-effect model "
+        "bypass the active-data lower bound (requires "
+        "--model-input-directory; reference GameEstimator.scala:127-133)",
+    )
+    p.add_argument(
         "--output-mode",
         default="BEST",
         choices=[m.name for m in ModelOutputMode],
@@ -241,6 +248,10 @@ def run(argv=None) -> dict:
         raise ValueError(
             "--partial-retrain-locked-coordinates requires --model-input-directory"
         )
+    if args.ignore_threshold_for_new_models and not args.model_input_directory:
+        raise ValueError(
+            "--ignore-threshold-for-new-models requires --model-input-directory"
+        )
     from photon_tpu.game.config import required_id_tags
 
     id_tags = sorted(required_id_tags(coordinate_configs.values()))
@@ -316,6 +327,7 @@ def run(argv=None) -> dict:
             update_sequence=update_sequence,
             descent_iterations=args.coordinate_descent_iterations,
             normalization_contexts=contexts,
+            ignore_threshold_for_new_models=args.ignore_threshold_for_new_models,
             locked_coordinates=locked,
             validation_evaluator=validation_evaluator,
         )

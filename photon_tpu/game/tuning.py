@@ -92,7 +92,11 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameTrainingResult]):
             for cid, cfg in self.estimator.coordinate_configs.items()
         }
         estimator = dataclasses.replace(
-            self.estimator, coordinate_configs=configs
+            self.estimator,
+            coordinate_configs=configs,
+            # tuning refits train from scratch (no initial model), so the
+            # warm-start-only threshold bypass must not carry over
+            ignore_threshold_for_new_models=False,
         )
         results = estimator.fit(
             self.train_data, validation_data=self.validation_data

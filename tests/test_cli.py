@@ -590,3 +590,109 @@ def test_game_training_and_scoring_with_mf_coordinate(tmp_path):
         ]
     )
     assert sres["evaluations"]["AUC"] > 0.7
+
+
+def test_game_training_warm_start_and_prior_flags(
+    avro_data, trained_model_dir, tmp_path
+):
+    """End-to-end incremental training: load the prior model, bypass the RE
+    lower bound for new entities only, and round-trip tuning observations
+    through the prior-JSON flags."""
+    prior_dir, _ = trained_model_dir
+    out = tmp_path / "retrain"
+    obs_path = tmp_path / "observations.json"
+    res = game_training.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--validation-data-directories", str(avro_data / "valid"),
+            "--root-output-directory", str(out),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=10,"
+            "regularization=L2,reg.weights=1",
+            "--coordinate-configurations",
+            "name=per-user,random.effect.type=userId,feature.shard=global,"
+            "max.iter=8,regularization=L2,reg.weights=1,"
+            "active.data.lower.bound=3",
+            "--coordinate-update-sequence", "global,per-user",
+            "--evaluators", "AUC",
+            "--model-input-directory", str(prior_dir / "best"),
+            "--ignore-threshold-for-new-models",
+            "--hyper-parameter-save-observations", str(obs_path),
+            "--output-mode", "BEST",
+        ]
+    )
+    assert res["results"]
+    # observations file usable as a prior for the next job
+    from photon_tpu.hyperparameter.serialization import priors_from_json
+
+    parsed = priors_from_json(
+        obs_path.read_text(), ["global", "per-user"]
+    )
+    assert parsed and all(np.isfinite(v) for _, v in parsed)
+    retrained = game_training.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--validation-data-directories", str(avro_data / "valid"),
+            "--root-output-directory", str(tmp_path / "tuned"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=10,"
+            "regularization=L2,reg.weights=1",
+            "--coordinate-update-sequence", "global",
+            "--evaluators", "AUC",
+            "--hyper-parameter-tuning", "BAYESIAN",
+            "--hyper-parameter-tuning-iter", "1",
+            "--hyper-parameter-prior-json", str(obs_path),
+            "--hyper-parameter-shrink-radius", "0.3",
+            "--output-mode", "NONE",
+        ]
+    )
+    assert len(retrained["results"]) == 2  # sweep + 1 tuned
+
+
+def test_ignore_threshold_flag_validations(avro_data, tmp_path):
+    with pytest.raises(ValueError, match="model-input-directory"):
+        game_training.run(
+            [
+                "--input-data-directories", str(avro_data / "train"),
+                "--root-output-directory", str(tmp_path / "x"),
+                "--training-task", "LOGISTIC_REGRESSION",
+                "--feature-shard-configurations", SHARD_ARG,
+                "--coordinate-configurations",
+                "name=global,feature.shard=global,max.iter=2",
+                "--coordinate-update-sequence", "global",
+                "--ignore-threshold-for-new-models",
+            ]
+        )
+
+
+def test_warm_start_flag_with_tuning(avro_data, trained_model_dir, tmp_path):
+    """ignore-threshold + Bayesian tuning in one job: tuning refits have no
+    initial model, so the flag must not propagate into them."""
+    prior_dir, _ = trained_model_dir
+    res = game_training.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--validation-data-directories", str(avro_data / "valid"),
+            "--root-output-directory", str(tmp_path / "wt"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=8,"
+            "regularization=L2,reg.weights=1",
+            "--coordinate-configurations",
+            "name=per-user,random.effect.type=userId,feature.shard=global,"
+            "max.iter=5,regularization=L2,reg.weights=1",
+            "--coordinate-update-sequence", "global,per-user",
+            "--evaluators", "AUC",
+            "--model-input-directory", str(prior_dir / "best"),
+            "--ignore-threshold-for-new-models",
+            "--hyper-parameter-tuning", "BAYESIAN",
+            "--hyper-parameter-tuning-iter", "1",
+            "--output-mode", "NONE",
+        ]
+    )
+    assert len(res["results"]) == 2  # sweep + 1 tuned candidate
