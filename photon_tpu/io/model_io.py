@@ -504,9 +504,20 @@ def save_scoring_results(
     weights: np.ndarray | None = None,
     uids: Sequence[str | None] | None = None,
 ) -> int:
-    """Write ScoringResultAvro records (ScoreProcessingUtils.scala:88)."""
+    """Write ScoringResultAvro records (ScoreProcessingUtils.scala:88).
+
+    The C++ block writer (native/avro_writer.cpp) handles the hot path;
+    the generic Python encoder is the fallback. Identical wire output is
+    asserted in tests/test_native_avro.py."""
     Path(path).parent.mkdir(parents=True, exist_ok=True)
     n = len(scores)
+
+    if os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
+        written = _save_scoring_results_native(
+            path, scores, model_id, labels, weights, uids
+        )
+        if written is not None:
+            return written
 
     def gen():
         for i in range(n):
@@ -520,6 +531,92 @@ def save_scoring_results(
             }
 
     return write_avro_file(path, schemas.SCORING_RESULT_AVRO, gen())
+
+
+def _save_scoring_results_native(
+    path, scores, model_id, labels, weights, uids
+) -> int | None:
+    """C++ writer; None ⇒ caller uses the Python encoder."""
+    import ctypes
+    import json
+
+    from photon_tpu.data.native_index import _load_native_lib
+
+    lib = _load_native_lib()
+    if lib is None or not hasattr(lib, "pml_write_scores"):
+        return None
+    lib.pml_write_scores.restype = ctypes.c_int
+    lib.pml_write_scores.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    n = len(scores)
+    uid_pool = b""
+    uid_offs = None
+    uid_valid_ptr = None
+    if uids is not None:
+        offs = np.zeros(n + 1, dtype=np.int64)
+        valid = np.zeros(n, dtype=np.uint8)
+        parts = []
+        total = 0
+        for i, u in enumerate(uids):
+            if u is not None:
+                b = str(u).encode("utf-8")
+                parts.append(b)
+                total += len(b)
+                valid[i] = 1  # explicit mask: "" stays distinct from None
+            offs[i + 1] = total
+        uid_pool = b"".join(parts)
+        uid_offs = offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        uid_valid_ptr = valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    scores64 = np.ascontiguousarray(scores, dtype=np.float64)
+    labels64 = (
+        None
+        if labels is None
+        else np.ascontiguousarray(labels, dtype=np.float64)
+    )
+    weights64 = (
+        None
+        if weights is None
+        else np.ascontiguousarray(weights, dtype=np.float64)
+    )
+    schema_json = json.dumps(schemas.SCORING_RESULT_AVRO).encode("utf-8")
+    mid = model_id.encode("utf-8")
+
+    def dptr(a):
+        return (
+            None
+            if a is None
+            else a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        )
+
+    rc = lib.pml_write_scores(
+        os.fsencode(str(path)),
+        schema_json,
+        len(schema_json),
+        ctypes.c_int64(n),
+        dptr(scores64),
+        dptr(labels64),
+        dptr(weights64),
+        uid_pool,
+        uid_offs,
+        uid_valid_ptr,
+        mid,
+        len(mid),
+        ctypes.c_int64(4096),
+    )
+    return n if rc == 0 else None
 
 
 def read_model_feature_keys(

@@ -269,3 +269,38 @@ def test_float_uid_takes_python_path():
         ],
     }
     assert compile_program(schema, []) is None
+
+
+@pytest.mark.parametrize("with_optional", [True, False])
+def test_native_scoring_writer_parity(tmp_path, with_optional):
+    """The C++ ScoringResultAvro writer and the generic Python encoder must
+    produce record-equivalent files (incl. null AND empty-string uids)."""
+    from photon_tpu.data.native_index import _load_native_lib
+    from photon_tpu.io.avro import read_avro_file
+    from photon_tpu.io.model_io import save_scoring_results
+
+    lib = _load_native_lib()
+    if lib is None or not hasattr(lib, "pml_write_scores"):
+        pytest.skip("native writer unavailable")
+
+    rng = np.random.default_rng(0)
+    n = 500
+    scores = rng.normal(size=n)
+    kw = {}
+    if with_optional:
+        uids = [f"id{i}" if i % 5 else None for i in range(n)]
+        uids[1] = ""  # empty string must survive as "", not null
+        kw = dict(
+            labels=(rng.uniform(size=n) > 0.5).astype(float),
+            weights=rng.uniform(0.5, 2.0, size=n),
+            uids=uids,
+        )
+    p_native = tmp_path / "native.avro"
+    p_python = tmp_path / "python.avro"
+    assert save_scoring_results(p_native, scores, model_id="m", **kw) == n
+    os.environ["PHOTON_NO_NATIVE_AVRO"] = "1"
+    try:
+        save_scoring_results(p_python, scores, model_id="m", **kw)
+    finally:
+        del os.environ["PHOTON_NO_NATIVE_AVRO"]
+    assert read_avro_file(p_native) == read_avro_file(p_python)
