@@ -1,7 +1,8 @@
 """Build hooks for photon-tpu.
 
-Compiles the native runtime (native/feature_index.cpp — the mmap feature
-index store reader, the TPU build's PalDB equivalent, SURVEY.md §2.9) into
+Compiles the native runtime (native/*.cpp — the mmap feature index store
+reader [PalDB equivalent, SURVEY.md §2.9], the columnar Avro decoder, and
+the scoring-output Avro writer) into
 ``photon_tpu/data/_native/libphoton_native.so`` so installed wheels carry
 the shared library. Source checkouts don't need this: the loader falls back
 to building ``native/`` with make on first use.
@@ -21,7 +22,7 @@ class BuildPyWithNative(build_py):
         dest = ROOT / "photon_tpu" / "data" / "_native"
         dest.mkdir(parents=True, exist_ok=True)
         out = dest / "libphoton_native.so"
-        src = ROOT / "native" / "feature_index.cpp"
+        srcs = sorted(str(p) for p in (ROOT / "native").glob("*.cpp"))
         cmd = [
             "g++",
             "-O2",
@@ -31,7 +32,8 @@ class BuildPyWithNative(build_py):
             "-shared",
             "-o",
             str(out),
-            str(src),
+            *srcs,
+            "-lz",
         ]
         try:
             subprocess.run(cmd, check=True)
