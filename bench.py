@@ -84,6 +84,14 @@ _PEAK_FLOPS = {
 #: validate the harness end-to-end on CPU (and in CI) without TPU time.
 SMOKE = os.environ.get("BENCH_SMOKE", "") == "1"
 
+
+def _pick(scale, smoke, cpu, tpu):
+    """Backend-aware shape selection. TPU gets the full BASELINE shapes;
+    the CPU fallback gets shapes a CPU finishes inside the per-config
+    timeout (every output records its n/d/… fields, so a CPU-scale number
+    can never masquerade as the TPU one)."""
+    return {"smoke": smoke, "cpu": cpu, "tpu": tpu}[scale]
+
 #: config name → (worker timeout seconds, attempts)
 CONFIG_PLAN = [
     ("a1a_logistic_lbfgs", 600, 3),
@@ -207,7 +215,8 @@ def _timed_run(fn, *args):
 # ---------------------------------------------------------------------------
 
 
-def config_a1a(peak_flops):
+def config_a1a(peak_flops, scale):
+    del scale  # a1a is tiny on every backend
     import jax
     import jax.numpy as jnp
 
@@ -267,7 +276,7 @@ def config_a1a(peak_flops):
 # ---------------------------------------------------------------------------
 
 
-def config_tron(peak_flops):
+def config_tron(peak_flops, scale):
     import jax
     import jax.numpy as jnp
 
@@ -277,7 +286,9 @@ def config_tron(peak_flops):
     from photon_tpu.types import LabeledBatch
 
     dtype = jnp.float32
-    n, d = (1 << 12, 256) if SMOKE else (1 << 19, 2048)
+    n, d = _pick(
+        scale, (1 << 12, 256), (1 << 16, 1024), (1 << 19, 2048)
+    )
     obj = GLMObjective(loss=SquaredLoss, l2_weight=1.0)
     cfg = OptimizerConfig().tron_defaults()
 
@@ -327,14 +338,19 @@ def config_tron(peak_flops):
     out = {"n": n, "d": d, **summarize(res, wall, 4.0)}
 
     # bfloat16 feature block (f32 MXU accumulation, f32 optimizer state):
-    # halves HBM traffic on the dominant [N, D] reads (VERDICT r2 weak #3)
-    res_b, wall_b = _timed_run(make_run(jnp.bfloat16), jax.random.PRNGKey(2))
-    out["bf16"] = summarize(res_b, wall_b, 2.0)
-    out["bf16"]["final_loss_rel_diff"] = round(
-        abs(float(res_b.value) - float(res.value))
-        / max(abs(float(res.value)), 1e-12),
-        6,
-    )
+    # halves HBM traffic on the dominant [N, D] reads (VERDICT r2 weak #3).
+    # Skipped on the CPU fallback — XLA:CPU emulates bf16 and the number
+    # would measure the emulation, not the feature.
+    if scale != "cpu":
+        res_b, wall_b = _timed_run(
+            make_run(jnp.bfloat16), jax.random.PRNGKey(2)
+        )
+        out["bf16"] = summarize(res_b, wall_b, 2.0)
+        out["bf16"]["final_loss_rel_diff"] = round(
+            abs(float(res_b.value) - float(res.value))
+            / max(abs(float(res.value)), 1e-12),
+            6,
+        )
     return out
 
 
@@ -345,7 +361,7 @@ def config_tron(peak_flops):
 # ---------------------------------------------------------------------------
 
 
-def config_sparse_poisson(peak_flops):
+def config_sparse_poisson(peak_flops, scale):
     import jax
     import jax.numpy as jnp
 
@@ -355,11 +371,16 @@ def config_sparse_poisson(peak_flops):
     from photon_tpu.types import SparseBatch
 
     dtype = jnp.float32
-    n, d, k = (1 << 13, 1 << 13, 16) if SMOKE else (1 << 20, 1 << 20, 56)
+    n, d, k = _pick(
+        scale,
+        (1 << 13, 1 << 13, 16),
+        (1 << 17, 1 << 17, 56),
+        (1 << 20, 1 << 20, 56),
+    )
     l1, l2 = 0.5e-3, 0.5e-3  # elastic net α=0.5, λ=1e-3
     obj = GLMObjective(loss=PoissonLoss, l2_weight=l2, l1_weight=l1)
     cfg = OptimizerConfig(
-        max_iterations=30 if SMOKE else 100, tolerance=1e-7
+        max_iterations=_pick(scale, 30, 50, 100), tolerance=1e-7
     )
 
     @jax.jit
@@ -654,38 +675,45 @@ def _run_game_config(
     }
 
 
-def config_glmix_estimator(peak_flops):
+def config_glmix_estimator(peak_flops, scale):
     """BASELINE config 4: FE + per-user RE through GameEstimator.fit with
     Zipf-skewed users — the number includes bucketing, padding waste,
     scatter scoring, and CD control flow (VERDICT r2 weak #2)."""
     del peak_flops
     return _run_game_config(
-        n=1 << 12 if SMOKE else 1 << 17,
-        fe_dim=32 if SMOKE else 128,
+        n=_pick(scale, 1 << 12, 1 << 15, 1 << 17),
+        fe_dim=_pick(scale, 32, 128, 128),
         fe_nnz=1 << 30,  # dense
-        coords_spec=[("user", 128, 8, 64)] if SMOKE
-        else [("user", 8192, 16, 1024)],
-        descent_iterations=2 if SMOKE else 3,
-        fe_max_iter=5 if SMOKE else 20,
-        re_max_iter=3 if SMOKE else 10,
+        coords_spec=_pick(
+            scale,
+            [("user", 128, 8, 64)],
+            [("user", 2048, 16, 512)],
+            [("user", 8192, 16, 1024)],
+        ),
+        descent_iterations=_pick(scale, 2, 3, 3),
+        fe_max_iter=_pick(scale, 5, 20, 20),
+        re_max_iter=_pick(scale, 3, 10, 10),
     )
 
 
-def config_game_ctr_scale(peak_flops):
+def config_game_ctr_scale(peak_flops, scale):
     """BASELINE config 5: sparse FE + per-user RE (2^20 users) + per-item RE
     (2^17 items) at CTR shape — the entity-axis scale demonstration
     (VERDICT r2 weak #4 / missing #2)."""
     del peak_flops
     return _run_game_config(
-        n=1 << 13 if SMOKE else 1 << 21,
-        fe_dim=1 << 10 if SMOKE else 1 << 17,
-        fe_nnz=8 if SMOKE else 24,
-        coords_spec=[("user", 1 << 10, 8, 32), ("item", 1 << 8, 8, 128)]
-        if SMOKE
-        else [("user", 1 << 20, 16, 256), ("item", 1 << 17, 16, 1024)],
+        n=_pick(scale, 1 << 13, 1 << 18, 1 << 21),
+        fe_dim=_pick(scale, 1 << 10, 1 << 14, 1 << 17),
+        fe_nnz=_pick(scale, 8, 24, 24),
+        coords_spec=_pick(
+            scale,
+            [("user", 1 << 10, 8, 32), ("item", 1 << 8, 8, 128)],
+            [("user", 1 << 16, 16, 128), ("item", 1 << 13, 16, 512)],
+            [("user", 1 << 20, 16, 256), ("item", 1 << 17, 16, 1024)],
+        ),
         descent_iterations=2,  # iteration 1 = steady state (post-compile)
-        fe_max_iter=4 if SMOKE else 10,
-        re_max_iter=3 if SMOKE else 5,
+        fe_max_iter=_pick(scale, 4, 8, 10),
+        re_max_iter=_pick(scale, 3, 4, 5),
     )
 
 
@@ -701,11 +729,13 @@ CONFIG_FNS = {
 def run_worker(name: str) -> None:
     t0 = time.perf_counter()
     platform, device_kind = _init_backend()
-    _log(f"[bench:{name}] backend={platform} kind={device_kind}")
+    scale = "smoke" if SMOKE else ("tpu" if platform == "tpu" else "cpu")
+    _log(f"[bench:{name}] backend={platform} kind={device_kind} scale={scale}")
     peak_flops, peak_dtype = _peak_for(device_kind, platform)
-    detail = CONFIG_FNS[name](peak_flops)
+    detail = CONFIG_FNS[name](peak_flops, scale)
     detail["backend"] = platform
     detail["device_kind"] = device_kind
+    detail["scale"] = scale
     detail["peak_flops_assumed"] = peak_flops
     detail["peak_flops_dtype"] = peak_dtype
     detail["worker_wall_s"] = round(time.perf_counter() - t0, 1)
@@ -727,13 +757,25 @@ def _emit(results: dict) -> None:
             if configs.get(name, {}).get("examples_per_sec") is not None:
                 headline = configs[name]["examples_per_sec"]
                 break
+    # the headline must carry its backend/scale: a CPU-fallback run uses
+    # reduced shapes and is NOT comparable to the TPU workload
+    headline_cfg = next(
+        (
+            configs[name]
+            for name, _, _ in CONFIG_PLAN
+            if configs.get(name, {}).get("examples_per_sec") == headline
+        ),
+        {},
+    )
     payload = {
         "metric": "GAME GLMix CD sweep throughput via GameEstimator.fit "
         "(FE + skewed per-user RE)",
         "value": headline,
         "unit": "examples/sec/chip",
+        "backend": headline_cfg.get("backend"),
+        "scale": headline_cfg.get("scale"),
         "vs_baseline": round(headline / SPARK_BASELINE_EXAMPLES_PER_SEC, 2)
-        if headline
+        if headline and headline_cfg.get("scale") == "tpu"
         else None,
         "vs_baseline_basis": VS_BASELINE_BASIS,
         **results,
