@@ -55,29 +55,36 @@ def make_mesh(
     return Mesh(arr, (BATCH_AXIS, ENTITY_AXIS))
 
 
-def shard_batch(batch, mesh: Mesh):
+def shard_batch(batch, mesh: Mesh, put=None):
     """Place a batch with rows sharded over every mesh device (the feature
     dimension replicated). Rows spread over both axes so a fixed-effect solve
     uses the whole mesh, not just the data axis. Works for both layouts: a
     sparse batch's [N, K] index/value blocks shard on rows exactly like the
     dense [N, D] block; the scatter-add output ([D]) is replicated, with XLA
-    inserting the psum."""
+    inserting the psum.
+
+    ``put(array, sharding)`` defaults to ``jax.device_put`` (single
+    controller); the multi-host path passes a ``make_array_from_callback``
+    placement instead (parallel/distributed.distribute_batch) so the field
+    mapping lives in exactly one place."""
+    if put is None:
+        put = jax.device_put
     axes = tuple(mesh.axis_names)
     row_sharded = NamedSharding(mesh, P(axes))
     mat_sharded = NamedSharding(mesh, P(axes, None))
     if isinstance(batch, SparseBatch):
         return SparseBatch(
-            indices=jax.device_put(batch.indices, mat_sharded),
-            values=jax.device_put(batch.values, mat_sharded),
-            labels=jax.device_put(batch.labels, row_sharded),
-            offsets=jax.device_put(batch.offsets, row_sharded),
-            weights=jax.device_put(batch.weights, row_sharded),
+            indices=put(batch.indices, mat_sharded),
+            values=put(batch.values, mat_sharded),
+            labels=put(batch.labels, row_sharded),
+            offsets=put(batch.offsets, row_sharded),
+            weights=put(batch.weights, row_sharded),
         )
     return LabeledBatch(
-        features=jax.device_put(batch.features, mat_sharded),
-        labels=jax.device_put(batch.labels, row_sharded),
-        offsets=jax.device_put(batch.offsets, row_sharded),
-        weights=jax.device_put(batch.weights, row_sharded),
+        features=put(batch.features, mat_sharded),
+        labels=put(batch.labels, row_sharded),
+        offsets=put(batch.offsets, row_sharded),
+        weights=put(batch.weights, row_sharded),
     )
 
 
