@@ -158,6 +158,12 @@ def parse_coordinate_config(
 
     re_type = kv.pop("random.effect.type", None)
     if re_type is None:
+        from photon_tpu.game.config import FeatureRepresentation
+
+        representation = FeatureRepresentation[
+            kv.pop("representation", "AUTO").upper()
+        ]
+        bf16 = kv.pop("bf16.features", "false").lower() in ("true", "1")
         if any(k.startswith("active.data") or k.startswith("passive") for k in kv):
             raise ValueError(
                 "active/passive data bounds only apply to random effects"
@@ -168,6 +174,8 @@ def parse_coordinate_config(
             feature_shard=shard,
             optimization=problem,
             regularization_weights=reg_weights,
+            representation=representation,
+            bf16_features=bf16,
         )
 
     upper = kv.pop("active.data.upper.bound", None)

@@ -45,12 +45,19 @@ class FeatureRepresentation(enum.Enum):
 
 @dataclasses.dataclass(frozen=True)
 class FixedEffectCoordinateConfig:
-    """One fixed-effect coordinate: whole-dataset GLM on a feature shard."""
+    """One fixed-effect coordinate: whole-dataset GLM on a feature shard.
+
+    ``bf16_features`` stores the dense feature block bfloat16 (halved HBM
+    traffic; MXU accumulates f32 via the objective's matvec/rmatvec paths)
+    while labels/weights/offsets and the optimizer state stay in the
+    estimator dtype. Ignored for sparse-ELL layouts.
+    """
 
     feature_shard: str
     optimization: GLMProblemConfig
     regularization_weights: Sequence[float] = (0.0,)
     representation: FeatureRepresentation = FeatureRepresentation.AUTO
+    bf16_features: bool = False
 
     @property
     def is_random_effect(self) -> bool:

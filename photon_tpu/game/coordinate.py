@@ -127,8 +127,9 @@ class FixedEffectCoordinate(Coordinate):
                 weights=np.asarray(weights, dtype=dtype),
             )
         else:
+            feat_dtype = jnp.bfloat16 if config.bf16_features else dtype
             batch = LabeledBatch(
-                features=shard.to_dense(dtype=dtype),
+                features=shard.to_dense(dtype=feat_dtype),
                 labels=np.asarray(data.labels, dtype=dtype),
                 offsets=np.asarray(data.offsets, dtype=dtype),
                 weights=np.asarray(weights, dtype=dtype),
@@ -142,13 +143,15 @@ class FixedEffectCoordinate(Coordinate):
             # holds the whole [N, D] block.
             batch = shard_batch(batch, mesh)
         else:
-            # preserve integer leaves (sparse ELL indices) as-is
-            batch = jax.tree_util.tree_map(
-                lambda x: jnp.asarray(x)
-                if np.issubdtype(np.asarray(x).dtype, np.integer)
-                else jnp.asarray(x, dtype=dtype),
-                batch,
-            )
+            # preserve integer leaves (sparse ELL indices) and an explicit
+            # bfloat16 feature block as-is
+            def _to_device(x):
+                a = np.asarray(x)
+                if np.issubdtype(a.dtype, np.integer) or a.dtype == jnp.bfloat16:
+                    return jnp.asarray(a)
+                return jnp.asarray(a, dtype=dtype)
+
+            batch = jax.tree_util.tree_map(_to_device, batch)
         problem = GLMProblem.build(
             config.optimization.with_regularization_weight(
                 config.regularization_weights[0]

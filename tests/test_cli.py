@@ -696,3 +696,21 @@ def test_warm_start_flag_with_tuning(avro_data, trained_model_dir, tmp_path):
         ]
     )
     assert len(res["results"]) == 2  # sweep + 1 tuned candidate
+
+
+def test_parse_fixed_effect_layout_keys():
+    from photon_tpu.cli.parsing import parse_coordinate_config
+    from photon_tpu.game.config import FeatureRepresentation
+    from photon_tpu.types import TaskType
+
+    name, cfg = parse_coordinate_config(
+        "name=g,feature.shard=global,representation=SPARSE,bf16.features=true",
+        TaskType.LOGISTIC_REGRESSION,
+    )
+    assert cfg.representation == FeatureRepresentation.SPARSE
+    assert cfg.bf16_features is True
+    with pytest.raises(ValueError, match="unknown coordinate config keys"):
+        parse_coordinate_config(
+            "name=g,feature.shard=global,bogus=1",
+            TaskType.LOGISTIC_REGRESSION,
+        )

@@ -468,3 +468,52 @@ def test_locked_coordinate_outside_update_sequence_kept_in_model():
         base["fixed"].model.coefficients.means,
         rtol=1e-12,
     )
+
+
+def test_fixed_effect_bf16_feature_storage():
+    """bf16_features stores the dense block bfloat16 with f32 state and
+    converges close to the f32 coordinate."""
+    import jax.numpy as jnp
+
+    from photon_tpu.game.config import (
+        FeatureRepresentation,
+        FixedEffectCoordinateConfig,
+    )
+    from photon_tpu.game.coordinate import FixedEffectCoordinate
+    from photon_tpu.optimize.problem import (
+        GLMProblemConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+
+    rng = np.random.default_rng(0)
+    n, d = 400, 12
+    x = rng.normal(size=(n, d))
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ (0.4 * rng.normal(size=d)))))).astype(float)
+    data = GameData.build(
+        labels=y, feature_shards={"g": CSRMatrix.from_dense(x)}
+    )
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    out = {}
+    for bf16 in (False, True):
+        cfg = FixedEffectCoordinateConfig(
+            feature_shard="g",
+            optimization=opt,
+            regularization_weights=(1.0,),
+            representation=FeatureRepresentation.DENSE,
+            bf16_features=bf16,
+        )
+        coord = FixedEffectCoordinate.build(data, cfg, dtype=jnp.float32)
+        expected = jnp.bfloat16 if bf16 else jnp.float32
+        assert coord.batch.features.dtype == expected
+        assert coord.batch.labels.dtype == jnp.float32
+        w, res = coord.train(
+            jnp.zeros(n, jnp.float32), coord.initial_state()
+        )
+        assert w.dtype == jnp.float32
+        out[bf16] = np.asarray(w)
+    np.testing.assert_allclose(out[True], out[False], rtol=0.05, atol=0.02)
