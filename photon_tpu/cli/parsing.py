@@ -18,6 +18,7 @@ import dataclasses
 from photon_tpu.evaluation.evaluators import EvaluatorType
 from photon_tpu.game.config import (
     CoordinateConfig,
+    FeatureRepresentation,
     FixedEffectCoordinateConfig,
     MatrixFactorizationCoordinateConfig,
     ProjectorType,
@@ -158,12 +159,15 @@ def parse_coordinate_config(
 
     re_type = kv.pop("random.effect.type", None)
     if re_type is None:
-        from photon_tpu.game.config import FeatureRepresentation
-
         representation = FeatureRepresentation[
             kv.pop("representation", "AUTO").upper()
         ]
-        bf16 = kv.pop("bf16.features", "false").lower() in ("true", "1")
+        bf16 = _pop_bool(kv, "bf16.features", False)
+        if bf16 and representation == FeatureRepresentation.SPARSE:
+            raise ValueError(
+                "bf16.features applies to dense feature blocks only "
+                "(sparse-ELL values stay f32)"
+            )
         if any(k.startswith("active.data") or k.startswith("passive") for k in kv):
             raise ValueError(
                 "active/passive data bounds only apply to random effects"

@@ -48,16 +48,18 @@ from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
 from photon_tpu.types import Array, LabeledBatch, SparseBatch
 
 
-def _use_sparse(representation: FeatureRepresentation, shard, dtype) -> bool:
+def _use_sparse(
+    representation: FeatureRepresentation, shard, dtype, bf16_features=False
+) -> bool:
     if representation == FeatureRepresentation.SPARSE:
         return True
     if representation == FeatureRepresentation.DENSE:
         return False
+    # the AUTO threshold tracks the actual dense footprint: bf16 storage
+    # halves it
+    itemsize = 2 if bf16_features else jnp.dtype(dtype).itemsize
     return choose_sparse(
-        shard.num_rows,
-        shard.num_cols,
-        len(shard.values),
-        itemsize=jnp.dtype(dtype).itemsize,
+        shard.num_rows, shard.num_cols, len(shard.values), itemsize=itemsize
     )
 
 
@@ -117,7 +119,9 @@ class FixedEffectCoordinate(Coordinate):
                 weights[~keep_draw] = 0.0
         # numpy handles bfloat16 via ml_dtypes, so one host-side conversion
         # covers every supported dtype
-        if _use_sparse(config.representation, shard, dtype):
+        if _use_sparse(
+            config.representation, shard, dtype, config.bf16_features
+        ):
             ell_idx, ell_val = shard.to_ell(dtype=dtype)
             batch = SparseBatch(
                 indices=ell_idx,

@@ -203,7 +203,12 @@ class DeviceValidationScorer:
                 nv = validation_data.num_samples
                 zeros = jnp.zeros((nv,), dtype)
                 ones = jnp.ones((nv,), dtype)
-                if _use_sparse(coord.config.representation, shard, dtype):
+                if _use_sparse(
+                    coord.config.representation,
+                    shard,
+                    dtype,
+                    coord.config.bf16_features,
+                ):
                     idx, val = shard.to_ell(dtype=np.dtype(dtype))
                     batch = SparseBatch(
                         indices=jnp.asarray(idx),

@@ -704,11 +704,23 @@ def test_parse_fixed_effect_layout_keys():
     from photon_tpu.types import TaskType
 
     name, cfg = parse_coordinate_config(
-        "name=g,feature.shard=global,representation=SPARSE,bf16.features=true",
+        "name=g,feature.shard=global,representation=DENSE,bf16.features=true",
         TaskType.LOGISTIC_REGRESSION,
     )
-    assert cfg.representation == FeatureRepresentation.SPARSE
+    assert cfg.representation == FeatureRepresentation.DENSE
     assert cfg.bf16_features is True
+    _, cfg2 = parse_coordinate_config(
+        "name=g,feature.shard=global,representation=SPARSE",
+        TaskType.LOGISTIC_REGRESSION,
+    )
+    assert cfg2.representation == FeatureRepresentation.SPARSE
+    # bf16 applies to dense blocks only
+    with pytest.raises(ValueError, match="dense"):
+        parse_coordinate_config(
+            "name=g,feature.shard=global,representation=SPARSE,"
+            "bf16.features=true",
+            TaskType.LOGISTIC_REGRESSION,
+        )
     with pytest.raises(ValueError, match="unknown coordinate config keys"):
         parse_coordinate_config(
             "name=g,feature.shard=global,bogus=1",
