@@ -63,7 +63,15 @@ def run(argv=None) -> dict:
             for cid, cm in model.coordinates.items():
                 log.info("coordinate %s: %s", cid, type(cm).__name__)
 
-        id_tags = sorted(model.required_id_tags())
+        from photon_tpu.evaluation.multi import GroupedEvaluatorSpec
+
+        requested = game_base.evaluators_from_args(args)
+        evaluator_tags = {
+            ev.id_tag
+            for ev in requested
+            if isinstance(ev, GroupedEvaluatorSpec)
+        }
+        id_tags = sorted(model.required_id_tags() | evaluator_tags)
         with Timed("read scoring data"):
             paths = game_base.resolve_input_paths(args)
             data, _ = game_base.read_game_data(
@@ -76,7 +84,6 @@ def run(argv=None) -> dict:
             scores = np.asarray(transformer.score(data))
 
         evaluations = {}
-        requested = game_base.evaluators_from_args(args)
         has_labels = bool(np.all(np.isfinite(data.labels)))
         if requested and not has_labels:
             log.warning("scoring data has missing labels; skipping evaluators")
@@ -88,8 +95,20 @@ def run(argv=None) -> dict:
             s = jnp.asarray(scores)
             lab = jnp.asarray(data.labels)
             w = jnp.asarray(data.weights)
+            # weight-0 rows are padding/masked by convention and excluded
+            # from grouped metrics (plain evaluators mask via the weights)
+            keep = np.asarray(data.weights) > 0
             for ev in requested:
-                evaluations[ev.name] = float(evaluate(ev, s, lab, w))
+                if isinstance(ev, GroupedEvaluatorSpec):
+                    evaluations[ev.name] = float(
+                        ev.build()(
+                            scores[keep],
+                            data.labels[keep],
+                            np.asarray(data.id_tags[ev.id_tag])[keep],
+                        )
+                    )
+                else:
+                    evaluations[ev.name] = float(evaluate(ev, s, lab, w))
                 log.info("%s = %.6f", ev.name, evaluations[ev.name])
 
         with Timed("save scores"):

@@ -252,11 +252,18 @@ def run(argv=None) -> dict:
         raise ValueError(
             "--ignore-threshold-for-new-models requires --model-input-directory"
         )
+    from photon_tpu.evaluation.multi import GroupedEvaluatorSpec
     from photon_tpu.game.config import required_id_tags
 
-    id_tags = sorted(required_id_tags(coordinate_configs.values()))
     evaluators = game_base.evaluators_from_args(args)
     validation_evaluator = evaluators[0] if evaluators else None
+    evaluator_tags = {
+        ev.id_tag for ev in evaluators if isinstance(ev, GroupedEvaluatorSpec)
+    }
+    # the training read needs only coordinate tags; evaluator-only tags are
+    # materialized on the (smaller) validation read alone
+    id_tags = sorted(required_id_tags(coordinate_configs.values()))
+    validation_id_tags = sorted(set(id_tags) | evaluator_tags)
 
     out_root = prepare_output_dir(
         args.root_output_directory, override=args.override_output_directory
@@ -289,7 +296,7 @@ def run(argv=None) -> dict:
                 )
                 v_paths = game_base.resolve_input_paths(v_args)
                 validation_data, _ = game_base.read_game_data(
-                    v_paths, shard_configs, index_maps, id_tags
+                    v_paths, shard_configs, index_maps, validation_id_tags
                 )
 
         with Timed("data validation"):

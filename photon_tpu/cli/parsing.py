@@ -210,18 +210,29 @@ def parse_coordinate_config(
     return name, config
 
 
-def parse_evaluators(s: str) -> list[EvaluatorType]:
-    """Comma-separated evaluator list (reference EvaluatorType.withName)."""
+def parse_evaluators(s: str):
+    """Comma-separated evaluator list (reference EvaluatorType.withName);
+    ``BASE:idTag`` tokens parse as grouped per-entity evaluators
+    (reference MultiEvaluatorType, e.g. ``AUC:queryId``,
+    ``PRECISION@5:documentId``)."""
+    from photon_tpu.evaluation.multi import parse_grouped_evaluator
+
     out = []
     for tok in s.split(LIST_DELIMITER):
-        tok = tok.strip().upper().replace("-", "_")
+        tok = tok.strip()
         if not tok:
             continue
+        grouped = parse_grouped_evaluator(tok)
+        if grouped is not None:
+            out.append(grouped)
+            continue
+        tok = tok.upper().replace("-", "_")
         try:
             out.append(EvaluatorType[tok])
         except KeyError:
             valid = ", ".join(e.name for e in EvaluatorType)
             raise ValueError(
-                f"unknown evaluator {tok!r}; expected one of {valid}"
+                f"unknown evaluator {tok!r}; expected one of {valid} or "
+                "BASE:idTag for grouped evaluation"
             ) from None
     return out

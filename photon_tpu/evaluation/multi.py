@@ -245,6 +245,62 @@ def precision_at_k(
     return MultiEvaluator.precision_at_k(k)(scores, labels, group_ids)
 
 
+@dataclasses.dataclass(frozen=True)
+class GroupedEvaluatorSpec:
+    """A parsed grouped-evaluator request, e.g. ``AUC:queryId`` or
+    ``PRECISION@5:documentId`` (reference MultiEvaluatorType.scala —
+    ``name + ':' + idTag`` with ``PRECISION@k`` as a parameterized name).
+    """
+
+    kind: str  # "AUC" | "RMSE" | "PRECISION_AT_K"
+    id_tag: str
+    k: int | None = None
+
+    @property
+    def name(self) -> str:
+        base = f"PRECISION@{self.k}" if self.kind == "PRECISION_AT_K" else self.kind
+        return f"{base}:{self.id_tag}"
+
+    @property
+    def larger_is_better(self) -> bool:
+        return self.kind != "RMSE"
+
+    def build(self) -> MultiEvaluator:
+        if self.kind == "AUC":
+            return MultiEvaluator.auc(self.id_tag)
+        if self.kind == "RMSE":
+            return MultiEvaluator.rmse(self.id_tag)
+        return MultiEvaluator.precision_at_k(self.k, self.id_tag)
+
+
+def parse_grouped_evaluator(token: str) -> GroupedEvaluatorSpec | None:
+    """``BASE[:idTag]`` → spec, or None when the token has no id tag
+    (callers then parse it as a plain EvaluatorType)."""
+    if ":" not in token:
+        return None
+    base, id_tag = token.split(":", 1)
+    base = base.strip().upper()
+    id_tag = id_tag.strip()
+    if not id_tag:
+        raise ValueError(f"grouped evaluator {token!r} has an empty id tag")
+    if base.startswith("PRECISION@"):
+        try:
+            k = int(base[len("PRECISION@"):])
+        except ValueError:
+            raise ValueError(
+                f"bad precision@k evaluator {token!r}"
+            ) from None
+        if k <= 0:
+            raise ValueError(f"precision@k requires k > 0: {token!r}")
+        return GroupedEvaluatorSpec(kind="PRECISION_AT_K", id_tag=id_tag, k=k)
+    if base in ("AUC", "RMSE"):
+        return GroupedEvaluatorSpec(kind=base, id_tag=id_tag)
+    raise ValueError(
+        f"unknown grouped evaluator {token!r}; expected AUC:<tag>, "
+        "RMSE:<tag>, or PRECISION@k:<tag>"
+    )
+
+
 def build_multi_evaluator(
     evaluator_type: EvaluatorType, id_tag: str = ""
 ) -> MultiEvaluator:

@@ -90,7 +90,10 @@ class GameEstimator:
     #: GameEstimator.ignoreThresholdForNewModels :127-133 →
     #: RandomEffectDataSet.generateActiveData). Requires ``initial_model``.
     ignore_threshold_for_new_models: bool = False
-    validation_evaluator: EvaluatorType | None = None
+    #: plain EvaluatorType, or a GroupedEvaluatorSpec (per-entity metric
+    #: like ``AUC:queryId`` — reference MultiEvaluatorType); per-sweep
+    #: evaluation runs on device either way
+    validation_evaluator: "EvaluatorType | object | None" = None
     #: (data, entity) device mesh; when set, fixed-effect batches shard
     #: rows over the whole mesh (gradient psums over ICI) and random-effect
     #: buckets shard entities over the entity axis — the reference's

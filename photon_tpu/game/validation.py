@@ -178,19 +178,27 @@ def _concat_aranges(lengths: np.ndarray) -> np.ndarray:
 
 @dataclasses.dataclass(eq=False)
 class DeviceValidationScorer:
-    """Built once per fit; ``evaluate(states)`` is all-device per sweep."""
+    """Built once per fit; ``evaluate(states)`` is all-device per sweep.
+
+    ``evaluator`` is a plain EvaluatorType or a GroupedEvaluatorSpec
+    (per-entity metric, e.g. ``AUC:queryId``); group codes are factorized
+    once at build so the per-sweep grouped evaluation stays one device
+    program."""
 
     scorers: dict
     labels: Array
     weights: Array
     offsets: Array
-    evaluator: EvaluatorType
+    evaluator: object
+    group_codes: Array | None = None
+    num_groups: int = 0
+    group_rows: Array | None = None  # positive-weight row indices
 
     @staticmethod
     def build(
         validation_data: GameData,
         coordinates: dict,
-        evaluator: EvaluatorType,
+        evaluator,
         dtype=jnp.float32,
     ) -> "DeviceValidationScorer":
         scorers: dict = {}
@@ -254,12 +262,39 @@ class DeviceValidationScorer:
             dtype if jnp.dtype(dtype) in (jnp.float32, jnp.float64)
             else jnp.float32
         )
+        group_codes = None
+        num_groups = 0
+        group_rows = None
+        from photon_tpu.evaluation.multi import GroupedEvaluatorSpec
+
+        if isinstance(evaluator, GroupedEvaluatorSpec):
+            if evaluator.id_tag not in validation_data.id_tags:
+                raise ValueError(
+                    f"grouped evaluator {evaluator.name!r} needs id tag "
+                    f"{evaluator.id_tag!r} on the validation data (present: "
+                    f"{sorted(validation_data.id_tags)})"
+                )
+            # weight-0 rows are padding/masked by convention (see
+            # evaluators.py) and must not pollute the grouped metric
+            keep = np.asarray(validation_data.weights) > 0
+            tags = np.asarray(validation_data.id_tags[evaluator.id_tag])[keep]
+            if len(tags) == 0:
+                raise ValueError(
+                    "grouped validation evaluator has no positive-weight rows"
+                )
+            _, codes = np.unique(tags, return_inverse=True)
+            group_codes = jnp.asarray(codes, jnp.int32)
+            num_groups = int(codes.max()) + 1
+            group_rows = jnp.asarray(np.flatnonzero(keep), jnp.int32)
         return DeviceValidationScorer(
             scorers=scorers,
             labels=jnp.asarray(validation_data.labels, eval_dtype),
             weights=jnp.asarray(validation_data.weights, eval_dtype),
             offsets=jnp.asarray(validation_data.offsets, eval_dtype),
             evaluator=evaluator,
+            group_codes=group_codes,
+            num_groups=num_groups,
+            group_rows=group_rows,
         )
 
     def margins(self, states: dict) -> Array:
@@ -269,5 +304,29 @@ class DeviceValidationScorer:
         return total
 
     def evaluate(self, states: dict) -> float:
+        from photon_tpu.evaluation.multi import (
+            GroupedEvaluatorSpec,
+            grouped_auc_device,
+            grouped_precision_at_k_device,
+            grouped_rmse_device,
+        )
+
         m = self.margins(states)
-        return float(evaluate(self.evaluator, m, self.labels, self.weights))
+        ev = self.evaluator
+        if isinstance(ev, GroupedEvaluatorSpec):
+            ms = m[self.group_rows]
+            ls = self.labels[self.group_rows]
+            if ev.kind == "AUC":
+                v, n_valid = grouped_auc_device(
+                    ms, ls, self.group_codes, self.num_groups
+                )
+            elif ev.kind == "PRECISION_AT_K":
+                v, n_valid = grouped_precision_at_k_device(
+                    ms, ls, self.group_codes, ev.k, self.num_groups
+                )
+            else:
+                v, n_valid = grouped_rmse_device(
+                    ms, ls, self.group_codes, self.num_groups
+                )
+            return float(v) if int(n_valid) > 0 else float("nan")
+        return float(evaluate(ev, m, self.labels, self.weights))

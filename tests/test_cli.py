@@ -726,3 +726,39 @@ def test_parse_fixed_effect_layout_keys():
             "name=g,feature.shard=global,bogus=1",
             TaskType.LOGISTIC_REGRESSION,
         )
+
+
+def test_parse_grouped_evaluators():
+    from photon_tpu.cli.parsing import parse_evaluators
+    from photon_tpu.evaluation.evaluators import EvaluatorType
+    from photon_tpu.evaluation.multi import GroupedEvaluatorSpec
+
+    evs = parse_evaluators("AUC, PRECISION@5:queryId, RMSE:docId")
+    assert evs[0] == EvaluatorType.AUC
+    assert isinstance(evs[1], GroupedEvaluatorSpec)
+    assert (evs[1].kind, evs[1].k, evs[1].id_tag) == ("PRECISION_AT_K", 5, "queryId")
+    assert evs[2].kind == "RMSE" and not evs[2].larger_is_better
+    with pytest.raises(ValueError, match="precision@k"):
+        parse_evaluators("PRECISION@x:queryId")
+    with pytest.raises(ValueError, match="grouped"):
+        parse_evaluators("LOGISTIC_LOSS:queryId")
+
+
+def test_training_driver_grouped_validation_evaluator(avro_data, tmp_path):
+    res = game_training.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--validation-data-directories", str(avro_data / "valid"),
+            "--root-output-directory", str(tmp_path / "gv"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=10,"
+            "regularization=L2,reg.weights=1",
+            "--coordinate-update-sequence", "global",
+            "--evaluators", "AUC:userId",
+            "--output-mode", "NONE",
+        ]
+    )
+    [r] = res["results"]
+    assert r.evaluation is not None and 0.0 <= r.evaluation <= 1.0

@@ -130,3 +130,37 @@ def test_device_validation_matches_transformer_with_mf():
     transformer = GameTransformer(model=res.model, task=est.task)
     via_model = transformer.evaluate(build(1, n_items=12), EvaluatorType.LOGISTIC_LOSS)
     np.testing.assert_allclose(res.evaluation, via_model, rtol=1e-6)
+
+
+def test_grouped_validation_evaluator_matches_transformer():
+    """validation_evaluator='AUC:userId' (reference MultiEvaluatorType):
+    per-sweep device evaluation must match the transformer's grouped path."""
+    from photon_tpu.evaluation.multi import parse_grouped_evaluator
+
+    train = _data(3, 300, 10)
+    valid = _data(4, 200, 10)
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=5, ls_max_iterations=5),
+    )
+    spec = parse_grouped_evaluator("AUC:userId")
+    assert spec is not None and spec.larger_is_better
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="global",
+                optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["fixed"],
+        descent_iterations=2,
+        validation_evaluator=spec,
+        dtype=jnp.float64,
+    )
+    [res] = est.fit(train, validation_data=valid)
+    assert res.evaluation is not None and 0.0 <= res.evaluation <= 1.0
+    transformer = GameTransformer(model=res.model, task=est.task)
+    via_model = transformer.evaluate_grouped(valid, spec.build(), "userId")
+    np.testing.assert_allclose(res.evaluation, via_model, rtol=1e-6)
