@@ -227,12 +227,15 @@ def to_device_sparse_batch(
         num_rows_padded=n_pad,
     )
     pad = n_pad - n
+    from photon_tpu.ops.sparse_windows import maybe_build_windows
+
     return SparseBatch(
         indices=jnp.asarray(indices),
         values=jnp.asarray(values, dtype=dtype),
         labels=jnp.asarray(np.pad(data.labels, (0, pad)), dtype=dtype),
         offsets=jnp.asarray(np.pad(data.offsets, (0, pad)), dtype=dtype),
         weights=jnp.asarray(np.pad(data.weights, (0, pad)), dtype=dtype),
+        windows=maybe_build_windows(indices, values, data.num_features),
     )
 
 

@@ -123,12 +123,18 @@ class FixedEffectCoordinate(Coordinate):
             config.representation, shard, dtype, config.bf16_features
         ):
             ell_idx, ell_val = shard.to_ell(dtype=dtype)
+            from photon_tpu.ops.sparse_windows import maybe_build_windows
+
             batch = SparseBatch(
                 indices=ell_idx,
                 values=ell_val,
                 labels=np.asarray(data.labels, dtype=dtype),
                 offsets=np.asarray(data.offsets, dtype=dtype),
                 weights=np.asarray(weights, dtype=dtype),
+                windows=maybe_build_windows(
+                    ell_idx, ell_val, shard.num_cols,
+                    sharded=mesh is not None,
+                ),
             )
         else:
             feat_dtype = jnp.bfloat16 if config.bf16_features else dtype
@@ -148,8 +154,11 @@ class FixedEffectCoordinate(Coordinate):
             batch = shard_batch(batch, mesh)
         else:
             # preserve integer leaves (sparse ELL indices) and an explicit
-            # bfloat16 feature block as-is
+            # bfloat16 feature block as-is; leaves already on device (the
+            # ColumnWindows layout) must NOT round-trip through host numpy
             def _to_device(x):
+                if isinstance(x, jax.Array):
+                    return x
                 a = np.asarray(x)
                 if np.issubdtype(a.dtype, np.integer) or a.dtype == jnp.bfloat16:
                     return jnp.asarray(a)

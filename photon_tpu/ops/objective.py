@@ -25,6 +25,7 @@ NormalizationContext is active (see ops/normalization.py).
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -65,9 +66,23 @@ def rmatvec(batch, per_row: Array, dim: int) -> Array:
 
     Sparse ELL: flat scatter-add over the N·K (index, value·r) pairs. Under
     pjit with rows sharded, each shard scatters into its own [dim] partial
-    and XLA inserts the psum — same collective the dense Xᵀr gets.
+    and XLA inserts the psum — same collective the dense Xᵀr gets. When the
+    batch carries a column-window layout (single-chip high-dim shards), the
+    scatter is rerouted through ops/sparse_windows — XLA:TPU's serialized
+    scatter lowering is minutes/eval at 10⁶-segment scale; the windowed
+    one-hot MXU kernel is milliseconds. ``PHOTON_SPARSE_RMATVEC=segment``
+    forces the plain path for A/B measurement.
     """
     if isinstance(batch, SparseBatch):
+        use_windows = (
+            getattr(batch, "windows", None) is not None
+            and per_row.ndim == 1
+            and os.environ.get("PHOTON_SPARSE_RMATVEC", "auto") != "segment"
+        )
+        if use_windows:
+            from photon_tpu.ops.sparse_windows import windowed_rmatvec
+
+            return windowed_rmatvec(batch.windows, per_row, dim)
         flat = (batch.values * per_row[:, None]).reshape(-1)
         return jax.ops.segment_sum(
             flat, batch.indices.reshape(-1), num_segments=dim

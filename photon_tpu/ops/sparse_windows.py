@@ -1,0 +1,323 @@
+"""Column-windowed sparse layout: the TPU-native Xᵀr kernel for high-dim GLMs.
+
+Why this exists: the padded-ELL backward pass (``ops/objective.py rmatvec``)
+is a flat scatter-add of N·K contributions into a [D] gradient —
+``jax.ops.segment_sum`` with D up to 2²⁰ segments. XLA:TPU lowers an
+unsorted many-collisions scatter to a serialized update loop, which at
+BASELINE config-3 scale (58M updates/eval) is minutes per evaluation —
+the one pattern on the chip that must not go through XLA's default
+lowering. (The reference never hits this cliff because its aggregator
+accumulates into a per-executor dense array in JVM memory,
+ValueAndGradientAggregator.scala:133-152; the TPU equivalent of that
+"local dense accumulate" is exactly this module.)
+
+The fix is a build-time layout + an MXU trick:
+
+- **Build** (host, once — indices are static across every objective
+  evaluation of a solve): sort the (row, col, val) triples by column and
+  bucket them into windows of ``window`` consecutive columns. Pad each
+  window to a common length L. Windows whose load exceeds L **spill** into
+  multiple instances mapped to the same output range — essential under
+  real feature skew (an intercept column alone holds N entries).
+- **Scatter → one-hot matmul**: within an instance, Xᵀr restricted to its
+  w columns is ``contribᵀ · onehot(local_cols)`` — a [1,L]×[L,w] matmul.
+  The Pallas kernel generates the one-hot **in VMEM** (never in HBM) and
+  feeds the MXU, so HBM traffic is just the (row, lcol, val) stream. A
+  pure-XLA ``lax.scan`` fallback computes the identical algebra for
+  CPU/debug, and a flat pre-sorted ``segment_sum`` variant exists for
+  comparison (padding uses local col w−1 so flat indices stay sorted).
+- **Gather side stays XLA**: contrib = vals · r[rows] is a gather from a
+  [N] vector, which XLA handles well; only the scatter needed rescue.
+
+Instance partials combine with one [W_inst, w] → [W, w] sorted
+segment-sum (thousands of rows, not millions — off the cliff).
+
+Sharded batches (parallel/mesh.shard_batch) intentionally drop the
+windows: under row-sharding each shard's partial gradient is a *replicated*
+[D] psum operand and the per-shard scatter is back on the segment_sum
+path; multi-chip high-dim shards should shard the window axis instead —
+future work, single-chip is where config 3 runs today.
+
+Selection: ``PHOTON_SPARSE_RMATVEC`` = auto (default) | pallas | onehot |
+flat | segment. AUTO → pallas on TPU, onehot elsewhere.
+"""
+from __future__ import annotations
+
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.types import Array
+
+_ENV = "PHOTON_SPARSE_RMATVEC"
+
+
+class ColumnWindows(NamedTuple):
+    """Static column-sorted instance layout (see module docstring).
+
+    rows/lcols/vals: [W_inst, L]; ``inst2win``: [W_inst] window id per
+    instance (non-decreasing); ``iota``: [w] = arange(window) — carried as
+    an array so the window width rides a static *shape* through jit (an int
+    leaf would be traced away) and doubles as the one-hot compare operand.
+    Padding slots: row 0, local col w−1, value 0.
+    """
+
+    rows: Array
+    lcols: Array
+    vals: Array
+    inst2win: Array
+    iota: Array
+
+    @property
+    def window(self) -> int:
+        return self.iota.shape[0]
+
+    @property
+    def instance_len(self) -> int:
+        return self.rows.shape[1]
+
+
+def build_column_windows(
+    indices: np.ndarray,
+    values: np.ndarray,
+    num_features: int,
+    *,
+    window: int = 128,
+    instance_cap: int = 4096,
+    chunk: int = 1024,
+) -> ColumnWindows:
+    """Host-side build from padded-ELL [N, K] arrays (vectorized numpy).
+
+    ``instance_cap`` bounds L so one hot column (intercept!) spills across
+    instances instead of inflating every window's padding. L is rounded up
+    to a multiple of ``chunk`` (the kernel's VMEM one-hot chunk) or to 8
+    for small layouts.
+    """
+    flat_col = np.asarray(indices).reshape(-1).astype(np.int64)
+    flat_val = np.asarray(values).reshape(-1)  # dtype preserved (f64 stays f64)
+    n, k = np.asarray(indices).shape
+    flat_row = np.repeat(np.arange(n, dtype=np.int64), k)
+    keep = flat_val != 0.0  # ELL padding slots carry value 0
+    flat_col, flat_val, flat_row = (
+        flat_col[keep],
+        flat_val[keep],
+        flat_row[keep],
+    )
+    nnz = flat_col.size
+    num_windows = max(1, -(-num_features // window))
+
+    order = np.argsort(flat_col, kind="stable")
+    s_col, s_val, s_row = flat_col[order], flat_val[order], flat_row[order]
+    s_win = s_col // window
+
+    counts = np.bincount(s_win, minlength=num_windows)
+    n_inst = np.maximum(1, -(-counts // instance_cap))
+    w_inst = int(n_inst.sum())
+    inst_base = np.concatenate([[0], np.cumsum(n_inst)])[:-1]
+
+    max_load = int(min(counts.max() if nnz else 1, instance_cap))
+    if max_load > chunk:
+        length = -(-max_load // chunk) * chunk
+    else:
+        length = max(8, -(-max_load // 8) * 8)
+
+    win_start = np.concatenate([[0], np.cumsum(counts)])
+    pos_in_win = np.arange(nnz, dtype=np.int64) - win_start[s_win]
+    inst = inst_base[s_win] + pos_in_win // instance_cap
+    pos = pos_in_win % instance_cap
+    dest = inst * length + pos
+
+    rows = np.zeros(w_inst * length, dtype=np.int32)
+    lcols = np.full(w_inst * length, window - 1, dtype=np.int32)
+    vals = np.zeros(w_inst * length, dtype=flat_val.dtype)
+    rows[dest] = s_row
+    lcols[dest] = s_col % window
+    vals[dest] = s_val
+
+    inst2win = np.repeat(
+        np.arange(num_windows, dtype=np.int32), n_inst
+    )
+    return ColumnWindows(
+        rows=jnp.asarray(rows.reshape(w_inst, length)),
+        lcols=jnp.asarray(lcols.reshape(w_inst, length)),
+        vals=jnp.asarray(vals.reshape(w_inst, length)),
+        inst2win=jnp.asarray(inst2win),
+        iota=jnp.arange(window, dtype=jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# rmatvec implementations (identical algebra, different lowering)
+# ---------------------------------------------------------------------------
+
+
+def _combine(out_inst: Array, windows: ColumnWindows, dim: int) -> Array:
+    """[W_inst, w] instance partials → [dim] gradient slice."""
+    w = windows.window
+    num_windows = max(1, -(-dim // w))
+    per_win = jax.ops.segment_sum(
+        out_inst,
+        windows.inst2win,
+        num_segments=num_windows,
+        indices_are_sorted=True,
+    )
+    return per_win.reshape(-1)[:dim]
+
+
+def _contrib(windows: ColumnWindows, per_row: Array) -> Array:
+    """vals · r[rows] — the gather-side product (padding rows hit r[0] with
+    value 0, contributing nothing)."""
+    return windows.vals * per_row[windows.rows]
+
+
+def rmatvec_windows_flat(
+    windows: ColumnWindows, per_row: Array, dim: int
+) -> Array:
+    """Pre-sorted flat segment_sum: padding local col w−1 keeps global
+    indices non-decreasing, so XLA sees ``indices_are_sorted``."""
+    w = windows.window
+    gcols = (windows.lcols + windows.inst2win[:, None] * w).reshape(-1)
+    num_windows = max(1, -(-dim // w))
+    out = jax.ops.segment_sum(
+        _contrib(windows, per_row).reshape(-1),
+        gcols,
+        num_segments=num_windows * w,
+        indices_are_sorted=True,
+    )
+    return out[:dim]
+
+
+def rmatvec_windows_onehot(
+    windows: ColumnWindows, per_row: Array, dim: int
+) -> Array:
+    """Pure-XLA one-hot matmul, scanned one instance at a time (the scan
+    keeps the [L, w] one-hot a fused per-step intermediate instead of a
+    materialized [W_inst, L, w] monster)."""
+    iota = windows.iota
+
+    def body(_, xs):
+        rows, lcols, vals = xs
+        cb = vals * per_row[rows]
+        onehot = (lcols[:, None] == iota[None, :]).astype(cb.dtype)
+        return None, cb @ onehot
+
+    _, out_inst = jax.lax.scan(
+        body, None, (windows.rows, windows.lcols, windows.vals)
+    )
+    return _combine(out_inst, windows, dim)
+
+
+def _pallas_kernel_factory(length: int, w: int, chunk: int):
+    from jax.experimental import pallas as pl
+
+    steps = max(1, length // chunk)
+
+    def kernel(contrib_ref, lcols_ref, out_ref):
+        def body(j, acc):
+            cb = contrib_ref[0, pl.ds(j * chunk, chunk)].astype(jnp.float32)
+            lc = lcols_ref[0, pl.ds(j * chunk, chunk)]
+            onehot = (
+                lc[:, None]
+                == jax.lax.broadcasted_iota(jnp.int32, (chunk, w), 1)
+            ).astype(jnp.float32)
+            return acc + jnp.dot(
+                cb[None, :], onehot, preferred_element_type=jnp.float32
+            )
+
+        acc = jax.lax.fori_loop(
+            0, steps, body, jnp.zeros((1, w), jnp.float32)
+        )
+        out_ref[0, :] = acc[0]
+
+    return kernel
+
+
+def rmatvec_windows_pallas(
+    windows: ColumnWindows,
+    per_row: Array,
+    dim: int,
+    *,
+    interpret: bool = False,
+) -> Array:
+    """Pallas kernel: one grid step per instance; the one-hot lives only in
+    VMEM and the multiply-accumulate runs on the MXU. HBM traffic is the
+    (lcol, contrib) stream — the layout's point."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    w_inst, length = windows.rows.shape
+    w = windows.window
+    # chunk must DIVIDE the instance length or the fori_loop drops the tail
+    # (build rounds length to a multiple of its chunk arg, which need not be
+    # this kernel's 1024 default) — pick the largest aligned divisor.
+    chunk = length
+    if length > 1024:
+        for c in (1024, 512, 256, 128, 64, 32, 16, 8):
+            if length % c == 0:
+                chunk = c
+                break
+        else:
+            raise ValueError(
+                f"instance length {length} has no aligned chunk divisor"
+            )
+    # f32 accumulation: the MXU path is TPU-only, where x64 is unsupported
+    contrib = _contrib(windows, per_row).astype(jnp.float32)
+
+    out_inst = pl.pallas_call(
+        _pallas_kernel_factory(length, w, chunk),
+        out_shape=jax.ShapeDtypeStruct((w_inst, w), jnp.float32),
+        grid=(w_inst,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, length), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, length), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, w), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(contrib, windows.lcols)
+    return _combine(out_inst, windows, dim)
+
+
+def maybe_build_windows(
+    indices: np.ndarray,
+    values: np.ndarray,
+    num_features: int,
+    *,
+    sharded: bool = False,
+):
+    """Policy gate for the layout build: windows are worth their host-side
+    sort + ~1.5× extra device memory only on TPU (where the scatter cliff
+    exists) at high dim, and never for sharded batches (see module
+    docstring). ``PHOTON_SPARSE_WINDOWS`` = auto (default) | 1 | 0."""
+    flag = os.environ.get("PHOTON_SPARSE_WINDOWS", "auto").strip().lower()
+    if sharded or flag in ("0", "off", "never"):
+        return None
+    if flag in ("1", "on", "always") or (
+        jax.default_backend() == "tpu" and num_features >= 1024
+    ):
+        return build_column_windows(indices, values, num_features)
+    return None
+
+
+def windowed_rmatvec(
+    windows: ColumnWindows, per_row: Array, dim: int
+) -> Array:
+    """Implementation dispatch (trace-time; see module docstring)."""
+    impl = os.environ.get(_ENV, "auto").strip().lower()
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "onehot"
+    if impl == "pallas":
+        return rmatvec_windows_pallas(windows, per_row, dim)
+    if impl == "onehot":
+        return rmatvec_windows_onehot(windows, per_row, dim)
+    if impl == "flat":
+        return rmatvec_windows_flat(windows, per_row, dim)
+    raise ValueError(f"unknown {_ENV}={impl!r}")

@@ -47,6 +47,12 @@ class SparseBatch(NamedTuple):
     indices: [N, K] int32, values: [N, K], labels/offsets/weights: [N].
     ``num_features`` is NOT carried here (an int leaf would be traced);
     it always comes from the coefficient vector's static shape.
+
+    ``windows`` optionally carries the column-sorted instance layout
+    (ops/sparse_windows.ColumnWindows) that reroutes the backward-pass
+    scatter around XLA:TPU's serialized-scatter cliff; None falls back to
+    the flat ``segment_sum`` path (always the case for sharded batches —
+    parallel/mesh.shard_batch drops it by design).
     """
 
     indices: Array
@@ -54,6 +60,7 @@ class SparseBatch(NamedTuple):
     labels: Array
     offsets: Array
     weights: Array
+    windows: Any = None
 
     @property
     def nnz_per_row(self) -> int:

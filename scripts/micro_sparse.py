@@ -1,0 +1,154 @@
+"""Microbenchmark: sparse ELL matvec/rmatvec strategies on the real chip.
+
+Dissects BASELINE config 3's hot ops (ops/objective.py matvec/rmatvec) to
+find where the time goes on TPU and which alternative wins:
+
+  m1. gather matvec            sum(v[idx] * val, -1)
+  r1. segment_sum rmatvec      (unsorted ELL order)      -- current code path
+  r2. segment_sum rmatvec      (pairs pre-sorted by col, indices_are_sorted)
+  r3. windowed one-hot matmul  (pairs sorted + bucketed into column windows
+                               at build time; scatter becomes MXU matmuls)
+
+Every timed call gets a DISTINCT input value (the relay memoizes identical
+(executable, inputs) re-executions — same-input timings read ~0 s).
+
+Usage: python scripts/micro_sparse.py [--n LOG2N] [--d LOG2D] [--k K]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def timed(fn, args_list):
+    """Warm on args_list[0], then time each remaining arg-tuple (distinct
+    inputs defeat relay-side result memoization); returns median seconds."""
+    import jax
+
+    jax.block_until_ready(fn(*args_list[0]))
+    outs = []
+    for args in args_list[1:]:
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        outs.append(time.perf_counter() - t0)
+    return float(np.median(outs))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=20)
+    ap.add_argument("--d", type=int, default=20)
+    ap.add_argument("--k", type=int, default=56)
+    ap.add_argument("--window", type=int, default=512)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    n, d, k, w = 1 << args.n, 1 << args.d, args.k, args.window
+    nnz = n * k
+    print(f"n={n} d={d} k={k} nnz={nnz} ({nnz * 8 / 1e9:.2f} GB idx+val)")
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, d, size=(n, k), dtype=np.int32)
+    val = (rng.normal(size=(n, k)) / np.sqrt(k)).astype(np.float32)
+
+    dev = jax.devices()[0]
+    print("device:", dev.device_kind, dev.platform)
+
+    idx_d = jax.device_put(jnp.asarray(idx))
+    val_d = jax.device_put(jnp.asarray(val))
+
+    def mk_vs(m, shape):
+        return [(jnp.asarray(rng.normal(size=shape).astype(np.float32)),)
+                for _ in range(m)]
+
+    # --- m1: gather matvec -------------------------------------------------
+    @jax.jit
+    def m1(v):
+        return jnp.sum(v[idx_d] * val_d, axis=-1)
+
+    t = timed(m1, mk_vs(4, d))
+    print(f"m1 gather matvec:            {t*1e3:9.2f} ms   "
+          f"{nnz * 8 / t / 1e9:8.1f} GB/s")
+
+    # --- r1: unsorted segment_sum -----------------------------------------
+    flat_idx = idx_d.reshape(-1)
+
+    @jax.jit
+    def r1(r):
+        return jax.ops.segment_sum(
+            (val_d * r[:, None]).reshape(-1), flat_idx, num_segments=d
+        )
+
+    t = timed(r1, mk_vs(4, n))
+    print(f"r1 unsorted segment_sum:     {t*1e3:9.2f} ms   "
+          f"{nnz * 8 / t / 1e9:8.1f} GB/s")
+
+    # --- r2: sorted segment_sum -------------------------------------------
+    order = np.argsort(idx.reshape(-1), kind="stable")
+    sorted_cols = jnp.asarray(idx.reshape(-1)[order])
+    row_of = jnp.asarray((order // k).astype(np.int32))
+    sorted_val = jnp.asarray(val.reshape(-1)[order])
+
+    @jax.jit
+    def r2(r):
+        contrib = sorted_val * r[row_of]
+        return jax.ops.segment_sum(
+            contrib, sorted_cols, num_segments=d, indices_are_sorted=True
+        )
+
+    t = timed(r2, mk_vs(4, n))
+    print(f"r2 sorted segment_sum:       {t*1e3:9.2f} ms   "
+          f"{nnz * 12 / t / 1e9:8.1f} GB/s")
+
+    # --- r3: windowed one-hot (XLA, materialized per block in scan) -------
+    # Pairs bucketed by column window (width w). Ragged -> padded [W, L].
+    n_win = d // w
+    win_of = idx.reshape(-1) // w
+    counts = np.bincount(win_of, minlength=n_win)
+    L = int(((counts.max() + 127) // 128) * 128)
+    print(f"r3 windows={n_win} width={w} maxload={counts.max()} pad_to={L} "
+          f"padding_waste={1 - nnz / (n_win * L):.3f}")
+    pad_rows = np.zeros((n_win, L), dtype=np.int32)
+    pad_cols = np.zeros((n_win, L), dtype=np.int32)
+    pad_val = np.zeros((n_win, L), dtype=np.float32)
+    off = np.zeros(n_win, dtype=np.int64)
+    flat_cols_np = idx.reshape(-1)
+    flat_val_np = val.reshape(-1)
+    srt = np.argsort(win_of, kind="stable")
+    sc, sr = flat_cols_np[srt], (srt // k).astype(np.int32)
+    sv = flat_val_np[srt]
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    for i in range(n_win):
+        c = counts[i]
+        pad_rows[i, :c] = sr[starts[i]:starts[i] + c]
+        pad_cols[i, :c] = sc[starts[i]:starts[i] + c] % w
+        pad_val[i, :c] = sv[starts[i]:starts[i] + c]
+        off[i] = starts[i]
+    pr = jax.device_put(jnp.asarray(pad_rows))
+    pc = jax.device_put(jnp.asarray(pad_cols))
+    pv = jax.device_put(jnp.asarray(pad_val))
+
+    @jax.jit
+    def r3(r):
+        contrib = pv * r[pr]  # [W, L]
+
+        def body(_, xs):
+            cb, lc = xs  # [L], [L]
+            onehot = (lc[:, None] == jnp.arange(w)[None, :]).astype(
+                jnp.float32
+            )
+            return None, cb @ onehot
+
+        _, out = jax.lax.scan(body, None, (contrib, pc))
+        return out.reshape(-1)
+
+    t = timed(r3, mk_vs(4, n))
+    print(f"r3 windowed one-hot scan:    {t*1e3:9.2f} ms   "
+          f"{nnz * 12 / t / 1e9:8.1f} GB/s")
+
+
+if __name__ == "__main__":
+    main()
