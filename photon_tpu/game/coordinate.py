@@ -236,6 +236,18 @@ class FixedEffectCoordinate(Coordinate):
         return FixedEffectModel(model=glm, feature_shard=self.feature_shard)
 
 
+def _uniquify_padding(sample_pos: np.ndarray, num_samples: int) -> np.ndarray:
+    """Renumber padding slots (== num_samples) to DISTINCT positions past
+    num_samples, so the bucket score scatter can promise unique indices
+    (colliding scatter-adds serialize on TPU; unique ones vectorize). The
+    residual gather clamps with ``jnp.minimum(sample_pos, n)``, so every
+    renumbered slot still reads the appended zero sentinel."""
+    sp = np.array(sample_pos, dtype=np.int32, copy=True)
+    pad = sp >= num_samples
+    sp[pad] = num_samples + np.arange(int(pad.sum()), dtype=np.int32)
+    return sp
+
+
 @dataclasses.dataclass(eq=False)
 class _DeviceBucket:
     features: Array  # [E, n, d]
@@ -243,7 +255,7 @@ class _DeviceBucket:
     offsets: Array
     weights: Array  # raw weights (scoring mask)
     train_weights: Array  # weights * active_mask
-    sample_pos: Array  # [E, n] int32, == num_samples for padding
+    sample_pos: Array  # [E, n] int32, ≥ num_samples ⇒ padding (unique)
     entity_ids: np.ndarray
     col_index: np.ndarray
 
@@ -315,7 +327,14 @@ class RandomEffectCoordinate(Coordinate):
                         )
                     ),
                     sample_pos=put_entities(
-                        jnp.asarray(pad_e(b.sample_pos, fill=dataset.num_samples))
+                        jnp.asarray(
+                            _uniquify_padding(
+                                pad_e(
+                                    b.sample_pos, fill=dataset.num_samples
+                                ),
+                                dataset.num_samples,
+                            )
+                        )
                     ),
                     entity_ids=b.entity_ids,
                     col_index=b.col_index,
@@ -398,8 +417,16 @@ class RandomEffectCoordinate(Coordinate):
     def _score_bucket(self, features, weights, sample_pos, coefs) -> Array:
         s = jnp.einsum("end,ed->en", features, coefs)
         s = jnp.where(weights > 0, s, 0.0)
-        out = jnp.zeros((self.num_samples + 1,), dtype=s.dtype)
-        out = out.at[sample_pos.reshape(-1)].add(s.reshape(-1))
+        # sample_pos slots are globally unique (padding slots were renumbered
+        # past num_samples at device placement), so the scatter can promise
+        # unique_indices — XLA:TPU's colliding-scatter lowering serializes,
+        # the unique path does not. The overflow tail is sliced off.
+        out = jnp.zeros(
+            (self.num_samples + sample_pos.size,), dtype=s.dtype
+        )
+        out = out.at[sample_pos.reshape(-1)].add(
+            s.reshape(-1), unique_indices=True
+        )
         return out[: self.num_samples]
 
     def score(self, state: list[Array]) -> Array:

@@ -65,7 +65,9 @@ class _RandomEffectValScorer:
                 continue
             c = coefs[blk.slots]
             s = jnp.einsum("md,md->m", blk.x_proj, c.astype(self.dtype))
-            out = out.at[blk.rows].add(s)
+            # each validation row belongs to exactly one entity and appears
+            # once per bucket block → honestly unique (TPU fast scatter)
+            out = out.at[blk.rows].add(s, unique_indices=True)
         return out
 
 

@@ -74,10 +74,13 @@ def rmatvec(batch, per_row: Array, dim: int) -> Array:
     forces the plain path for A/B measurement.
     """
     if isinstance(batch, SparseBatch):
+        impl = os.environ.get(
+            "PHOTON_SPARSE_RMATVEC", "auto"
+        ).strip().lower()
         use_windows = (
             getattr(batch, "windows", None) is not None
             and per_row.ndim == 1
-            and os.environ.get("PHOTON_SPARSE_RMATVEC", "auto") != "segment"
+            and impl != "segment"
         )
         if use_windows:
             from photon_tpu.ops.sparse_windows import windowed_rmatvec

@@ -114,20 +114,24 @@ def build_column_windows(
     s_win = s_col // window
 
     counts = np.bincount(s_win, minlength=num_windows)
-    n_inst = np.maximum(1, -(-counts // instance_cap))
+    # Round the spill cap itself to the instance length so FULL spill
+    # instances carry zero padding — mid-stream padding (local col w−1
+    # between two instances of the same window) would break the sorted
+    # invariant rmatvec_windows_flat promises to XLA.
+    cap = int(min(counts.max() if nnz else 1, instance_cap))
+    if cap > chunk:
+        cap = -(-cap // chunk) * chunk
+    else:
+        cap = max(8, -(-cap // 8) * 8)
+    length = cap
+    n_inst = np.maximum(1, -(-counts // cap))
     w_inst = int(n_inst.sum())
     inst_base = np.concatenate([[0], np.cumsum(n_inst)])[:-1]
 
-    max_load = int(min(counts.max() if nnz else 1, instance_cap))
-    if max_load > chunk:
-        length = -(-max_load // chunk) * chunk
-    else:
-        length = max(8, -(-max_load // 8) * 8)
-
     win_start = np.concatenate([[0], np.cumsum(counts)])
     pos_in_win = np.arange(nnz, dtype=np.int64) - win_start[s_win]
-    inst = inst_base[s_win] + pos_in_win // instance_cap
-    pos = pos_in_win % instance_cap
+    inst = inst_base[s_win] + pos_in_win // cap
+    pos = pos_in_win % cap
     dest = inst * length + pos
 
     rows = np.zeros(w_inst * length, dtype=np.int32)

@@ -17,9 +17,15 @@ Usage: python scripts/micro_sparse.py [--n LOG2N] [--d LOG2D] [--k K]
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import numpy as np
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    import jax as _jax  # sitecustomize force-selects the axon relay
+
+    _jax.config.update("jax_platforms", "cpu")
 
 
 def timed(fn, args_list):
@@ -105,7 +111,7 @@ def main():
 
     # --- r3: windowed one-hot (XLA, materialized per block in scan) -------
     # Pairs bucketed by column window (width w). Ragged -> padded [W, L].
-    n_win = d // w
+    n_win = -(-d // w)
     win_of = idx.reshape(-1) // w
     counts = np.bincount(win_of, minlength=n_win)
     L = int(((counts.max() + 127) // 128) * 128)
@@ -148,6 +154,54 @@ def main():
     t = timed(r3, mk_vs(4, n))
     print(f"r3 windowed one-hot scan:    {t*1e3:9.2f} ms   "
           f"{nnz * 12 / t / 1e9:8.1f} GB/s")
+
+    # --- s1: permutation scatter (RE scoring shape, unique indices) -------
+    m = n
+    perm = jax.device_put(jnp.asarray(rng.permutation(m).astype(np.int32)))
+
+    @jax.jit
+    def s1u(x):
+        return jnp.zeros((m,), jnp.float32).at[perm].add(
+            x, unique_indices=True
+        )
+
+    @jax.jit
+    def s1n(x):
+        return jnp.zeros((m,), jnp.float32).at[perm].add(x)
+
+    t = timed(s1u, mk_vs(4, m))
+    print(f"s1 unique perm scatter:      {t*1e3:9.2f} ms   "
+          f"{m * 8 / t / 1e9:8.1f} GB/s")
+    t = timed(s1n, mk_vs(4, m))
+    print(f"s1 same, unflagged:          {t*1e3:9.2f} ms   "
+          f"{m * 8 / t / 1e9:8.1f} GB/s")
+
+    # --- s2: sorted segment_sum into n/8 groups (grouped-eval shape) ------
+    groups = np.sort(rng.integers(0, m // 8, size=m)).astype(np.int32)
+    g_d = jax.device_put(jnp.asarray(groups))
+
+    @jax.jit
+    def s2(x):
+        return jax.ops.segment_sum(
+            x, g_d, num_segments=m // 8, indices_are_sorted=True
+        )
+
+    t = timed(s2, mk_vs(4, m))
+    print(f"s2 sorted seg_sum n/8 grps:  {t*1e3:9.2f} ms   "
+          f"{m * 8 / t / 1e9:8.1f} GB/s")
+
+    # --- s3: gather from a large table (RE coef gather shape) -------------
+    tbl = jax.device_put(
+        jnp.asarray(rng.standard_normal(m).astype(np.float32))
+    )
+
+    @jax.jit
+    def s3(x):
+        return tbl[perm] * x
+
+    t = timed(s3, mk_vs(4, m))
+    print(f"s3 perm gather [m]<-[m]:     {t*1e3:9.2f} ms   "
+          f"{m * 12 / t / 1e9:8.1f} GB/s")
 
 
 if __name__ == "__main__":

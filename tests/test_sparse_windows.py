@@ -82,6 +82,27 @@ def test_pallas_chunk_divides_nondefault_length():
     assert got[0] == pytest.approx(n * k)
 
 
+def test_flat_sorted_invariant_with_misaligned_cap():
+    """Regression: a spill cap that is not a multiple of the length rounding
+    must not leave mid-stream padding that breaks the non-decreasing global
+    column order rmatvec_windows_flat promises XLA."""
+    rng = np.random.default_rng(11)
+    n, k, d = 500, 3, 64
+    idx, val = _random_ell(rng, n, k, d, hot_column=True, zero_slots=False)
+    windows = build_column_windows(
+        idx, val, d, window=16, instance_cap=100, chunk=16
+    )
+    w = windows.window
+    gcols = np.asarray(windows.lcols) + np.asarray(windows.inst2win)[:, None] * w
+    assert np.all(np.diff(gcols.reshape(-1)) >= 0), "flat order not sorted"
+    r = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    got = np.asarray(rmatvec_windows_flat(windows, r, d))
+    np.testing.assert_allclose(
+        got, _reference_rmatvec(idx, val, np.asarray(r), d),
+        rtol=2e-4, atol=1e-4,
+    )
+
+
 def test_float64_values_preserved():
     rng = np.random.default_rng(10)
     idx, val = _random_ell(rng, 32, 3, 64)
