@@ -218,6 +218,28 @@ class GLMObjective:
         d2 = batch.weights * self.loss.d2(z, batch.labels)
         dim = coef.shape[-1]
         if isinstance(batch, SparseBatch):
+            windows = getattr(batch, "windows", None)
+            if windows is not None and d2.ndim == 1:
+                # same scatter-cliff reroute as rmatvec: Σᵢ d2ᵢ·xᵢⱼ² is a
+                # windowed Xᵀ·d2 with squared stored values
+                from photon_tpu.ops.sparse_windows import windowed_rmatvec
+
+                sq_windows = windows._replace(
+                    vals=jnp.square(windows.vals)
+                )
+                sq = windowed_rmatvec(sq_windows, d2, dim)
+                if self.normalization.shifts is not None:
+                    lin = windowed_rmatvec(windows, d2, dim)
+                    shifts = self.normalization.shifts
+                    sq = (
+                        sq
+                        - 2.0 * shifts * lin
+                        + jnp.square(shifts) * jnp.sum(d2)
+                    )
+                diag = sq
+                if self.normalization.factors is not None:
+                    diag = diag * jnp.square(self.normalization.factors)
+                return diag + self.l2_weight
             flat_idx = batch.indices.reshape(-1)
             sq = jax.ops.segment_sum(
                 (jnp.square(batch.values) * d2[:, None]).reshape(-1),

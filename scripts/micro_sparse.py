@@ -1,18 +1,26 @@
-"""Microbenchmark: sparse ELL matvec/rmatvec strategies on the real chip.
+"""Microbenchmark: sparse gather/scatter strategies on the real chip.
 
-Dissects BASELINE config 3's hot ops (ops/objective.py matvec/rmatvec) to
-find where the time goes on TPU and which alternative wins:
+Dissects BASELINE config 3's hot ops to find where time goes on TPU and
+which lowering wins:
 
-  m1. gather matvec            sum(v[idx] * val, -1)
-  r1. segment_sum rmatvec      (unsorted ELL order)      -- current code path
-  r2. segment_sum rmatvec      (pairs pre-sorted by col, indices_are_sorted)
-  r3. windowed one-hot matmul  (pairs sorted + bucketed into column windows
-                               at build time; scatter becomes MXU matmuls)
+  m1. gather matvec             sum(v[idx] * val, -1)       (forward margin)
+  r1. unsorted segment_sum      flat ELL scatter            (old rmatvec)
+  r2. sorted segment_sum        col-sorted + indices_are_sorted
+  r3. windowed one-hot scan     pure-XLA production variant
+  p1. windowed one-hot Pallas   production kernel (ops/sparse_windows.py)
+  s1. permutation scatter       unique_indices True vs False (RE scoring)
+  s2. sorted segment_sum n/8    grouped-eval shape
+  s3. permutation gather        RE coefficient gather shape
 
 Every timed call gets a DISTINCT input value (the relay memoizes identical
 (executable, inputs) re-executions — same-input timings read ~0 s).
 
-Usage: python scripts/micro_sparse.py [--n LOG2N] [--d LOG2D] [--k K]
+``--only CASE`` runs a single case so a driver can subprocess each with
+its own timeout: a wedged scatter lowering then costs one case, not the
+harness (and the chip recovers when that subprocess's program ends).
+
+Usage:
+  python scripts/micro_sparse.py [--n LOG2N] [--d LOG2D] [--k K] [--only m1]
 """
 from __future__ import annotations
 
@@ -48,160 +56,155 @@ def main():
     ap.add_argument("--d", type=int, default=20)
     ap.add_argument("--k", type=int, default=56)
     ap.add_argument("--window", type=int, default=512)
+    ap.add_argument("--only", default=None,
+                    help="single case: m1,r1,r2,r3,p1,s1,s2,s3")
     args = ap.parse_args()
+
+    def want(name):
+        return args.only is None or args.only == name
 
     import jax
     import jax.numpy as jnp
 
     n, d, k, w = 1 << args.n, 1 << args.d, args.k, args.window
     nnz = n * k
-    print(f"n={n} d={d} k={k} nnz={nnz} ({nnz * 8 / 1e9:.2f} GB idx+val)")
+    print(f"n={n} d={d} k={k} nnz={nnz} ({nnz * 8 / 1e9:.2f} GB idx+val)",
+          flush=True)
     rng = np.random.default_rng(0)
-    idx = rng.integers(0, d, size=(n, k), dtype=np.int32)
-    val = (rng.normal(size=(n, k)) / np.sqrt(k)).astype(np.float32)
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = (rng.standard_normal((n, k)) / np.sqrt(k)).astype(np.float32)
 
     dev = jax.devices()[0]
-    print("device:", dev.device_kind, dev.platform)
+    print("device:", dev.device_kind, dev.platform, flush=True)
 
-    idx_d = jax.device_put(jnp.asarray(idx))
-    val_d = jax.device_put(jnp.asarray(val))
+    def report(name, t, bytes_moved):
+        print(f"{name:28s} {t*1e3:9.2f} ms   "
+              f"{bytes_moved / t / 1e9:8.1f} GB/s", flush=True)
 
     def mk_vs(m, shape):
-        return [(jnp.asarray(rng.normal(size=shape).astype(np.float32)),)
+        return [(jnp.asarray(rng.standard_normal(shape).astype(np.float32)),)
                 for _ in range(m)]
 
-    # --- m1: gather matvec -------------------------------------------------
-    @jax.jit
-    def m1(v):
-        return jnp.sum(v[idx_d] * val_d, axis=-1)
+    if want("m1") or want("r1"):
+        idx_d = jax.device_put(jnp.asarray(idx))
+        val_d = jax.device_put(jnp.asarray(val))
 
-    t = timed(m1, mk_vs(4, d))
-    print(f"m1 gather matvec:            {t*1e3:9.2f} ms   "
-          f"{nnz * 8 / t / 1e9:8.1f} GB/s")
+    if want("m1"):
+        @jax.jit
+        def m1(v):
+            return jnp.sum(v[idx_d] * val_d, axis=-1)
 
-    # --- r1: unsorted segment_sum -----------------------------------------
-    flat_idx = idx_d.reshape(-1)
+        report("m1 gather matvec", timed(m1, mk_vs(4, d)), nnz * 8)
 
-    @jax.jit
-    def r1(r):
-        return jax.ops.segment_sum(
-            (val_d * r[:, None]).reshape(-1), flat_idx, num_segments=d
-        )
+    if want("r1"):
+        flat_idx = idx_d.reshape(-1)
 
-    t = timed(r1, mk_vs(4, n))
-    print(f"r1 unsorted segment_sum:     {t*1e3:9.2f} ms   "
-          f"{nnz * 8 / t / 1e9:8.1f} GB/s")
-
-    # --- r2: sorted segment_sum -------------------------------------------
-    order = np.argsort(idx.reshape(-1), kind="stable")
-    sorted_cols = jnp.asarray(idx.reshape(-1)[order])
-    row_of = jnp.asarray((order // k).astype(np.int32))
-    sorted_val = jnp.asarray(val.reshape(-1)[order])
-
-    @jax.jit
-    def r2(r):
-        contrib = sorted_val * r[row_of]
-        return jax.ops.segment_sum(
-            contrib, sorted_cols, num_segments=d, indices_are_sorted=True
-        )
-
-    t = timed(r2, mk_vs(4, n))
-    print(f"r2 sorted segment_sum:       {t*1e3:9.2f} ms   "
-          f"{nnz * 12 / t / 1e9:8.1f} GB/s")
-
-    # --- r3: windowed one-hot (XLA, materialized per block in scan) -------
-    # Pairs bucketed by column window (width w). Ragged -> padded [W, L].
-    n_win = -(-d // w)
-    win_of = idx.reshape(-1) // w
-    counts = np.bincount(win_of, minlength=n_win)
-    L = int(((counts.max() + 127) // 128) * 128)
-    print(f"r3 windows={n_win} width={w} maxload={counts.max()} pad_to={L} "
-          f"padding_waste={1 - nnz / (n_win * L):.3f}")
-    pad_rows = np.zeros((n_win, L), dtype=np.int32)
-    pad_cols = np.zeros((n_win, L), dtype=np.int32)
-    pad_val = np.zeros((n_win, L), dtype=np.float32)
-    off = np.zeros(n_win, dtype=np.int64)
-    flat_cols_np = idx.reshape(-1)
-    flat_val_np = val.reshape(-1)
-    srt = np.argsort(win_of, kind="stable")
-    sc, sr = flat_cols_np[srt], (srt // k).astype(np.int32)
-    sv = flat_val_np[srt]
-    starts = np.concatenate([[0], np.cumsum(counts)])
-    for i in range(n_win):
-        c = counts[i]
-        pad_rows[i, :c] = sr[starts[i]:starts[i] + c]
-        pad_cols[i, :c] = sc[starts[i]:starts[i] + c] % w
-        pad_val[i, :c] = sv[starts[i]:starts[i] + c]
-        off[i] = starts[i]
-    pr = jax.device_put(jnp.asarray(pad_rows))
-    pc = jax.device_put(jnp.asarray(pad_cols))
-    pv = jax.device_put(jnp.asarray(pad_val))
-
-    @jax.jit
-    def r3(r):
-        contrib = pv * r[pr]  # [W, L]
-
-        def body(_, xs):
-            cb, lc = xs  # [L], [L]
-            onehot = (lc[:, None] == jnp.arange(w)[None, :]).astype(
-                jnp.float32
+        @jax.jit
+        def r1(r):
+            return jax.ops.segment_sum(
+                (val_d * r[:, None]).reshape(-1), flat_idx, num_segments=d
             )
-            return None, cb @ onehot
 
-        _, out = jax.lax.scan(body, None, (contrib, pc))
-        return out.reshape(-1)
+        report("r1 unsorted segment_sum", timed(r1, mk_vs(4, n)), nnz * 8)
 
-    t = timed(r3, mk_vs(4, n))
-    print(f"r3 windowed one-hot scan:    {t*1e3:9.2f} ms   "
-          f"{nnz * 12 / t / 1e9:8.1f} GB/s")
+    if want("r2"):
+        order = np.argsort(idx.reshape(-1), kind="stable")
+        sorted_cols = jax.device_put(jnp.asarray(idx.reshape(-1)[order]))
+        row_of = jax.device_put(
+            jnp.asarray((order // k).astype(np.int32))
+        )
+        sorted_val = jax.device_put(jnp.asarray(val.reshape(-1)[order]))
 
-    # --- s1: permutation scatter (RE scoring shape, unique indices) -------
+        @jax.jit
+        def r2(r):
+            contrib = sorted_val * r[row_of]
+            return jax.ops.segment_sum(
+                contrib, sorted_cols, num_segments=d,
+                indices_are_sorted=True,
+            )
+
+        report("r2 sorted segment_sum", timed(r2, mk_vs(4, n)), nnz * 12)
+
+    if want("r3") or want("p1"):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from photon_tpu.ops.sparse_windows import (
+            build_column_windows,
+            rmatvec_windows_onehot,
+            rmatvec_windows_pallas,
+        )
+
+        t0 = time.perf_counter()
+        windows = build_column_windows(idx, val, d, window=args.window)
+        wi, length = windows.rows.shape
+        print(f"windows: {wi} instances x {length} (width {args.window}) "
+              f"waste={1 - nnz / (wi * length):.3f} "
+              f"build={time.perf_counter() - t0:.1f}s", flush=True)
+
+        if want("r3"):
+            @jax.jit
+            def r3(r):
+                return rmatvec_windows_onehot(windows, r, d)
+
+            report("r3 windowed one-hot scan", timed(r3, mk_vs(4, n)),
+                   nnz * 12)
+
+        if want("p1"):
+            if dev.platform != "tpu":
+                print("p1 windowed one-hot Pallas:   skipped (TPU only)",
+                      flush=True)
+            else:
+                @jax.jit
+                def p1(r):
+                    return rmatvec_windows_pallas(windows, r, d)
+
+                report("p1 windowed one-hot Pallas", timed(p1, mk_vs(4, n)),
+                       nnz * 12)
+
     m = n
-    perm = jax.device_put(jnp.asarray(rng.permutation(m).astype(np.int32)))
-
-    @jax.jit
-    def s1u(x):
-        return jnp.zeros((m,), jnp.float32).at[perm].add(
-            x, unique_indices=True
+    if want("s1") or want("s3"):
+        perm = jax.device_put(
+            jnp.asarray(rng.permutation(m).astype(np.int32))
         )
 
-    @jax.jit
-    def s1n(x):
-        return jnp.zeros((m,), jnp.float32).at[perm].add(x)
+    if want("s1"):
+        @jax.jit
+        def s1u(x):
+            return jnp.zeros((m,), jnp.float32).at[perm].add(
+                x, unique_indices=True
+            )
 
-    t = timed(s1u, mk_vs(4, m))
-    print(f"s1 unique perm scatter:      {t*1e3:9.2f} ms   "
-          f"{m * 8 / t / 1e9:8.1f} GB/s")
-    t = timed(s1n, mk_vs(4, m))
-    print(f"s1 same, unflagged:          {t*1e3:9.2f} ms   "
-          f"{m * 8 / t / 1e9:8.1f} GB/s")
+        @jax.jit
+        def s1n(x):
+            return jnp.zeros((m,), jnp.float32).at[perm].add(x)
 
-    # --- s2: sorted segment_sum into n/8 groups (grouped-eval shape) ------
-    groups = np.sort(rng.integers(0, m // 8, size=m)).astype(np.int32)
-    g_d = jax.device_put(jnp.asarray(groups))
+        report("s1 unique perm scatter", timed(s1u, mk_vs(4, m)), m * 8)
+        report("s1 same, unflagged", timed(s1n, mk_vs(4, m)), m * 8)
 
-    @jax.jit
-    def s2(x):
-        return jax.ops.segment_sum(
-            x, g_d, num_segments=m // 8, indices_are_sorted=True
+    if want("s2"):
+        groups = np.sort(rng.integers(0, m // 8, size=m)).astype(np.int32)
+        g_d = jax.device_put(jnp.asarray(groups))
+
+        @jax.jit
+        def s2(x):
+            return jax.ops.segment_sum(
+                x, g_d, num_segments=m // 8, indices_are_sorted=True
+            )
+
+        report("s2 sorted seg_sum n/8 grps", timed(s2, mk_vs(4, m)), m * 8)
+
+    if want("s3"):
+        tbl = jax.device_put(
+            jnp.asarray(rng.standard_normal(m).astype(np.float32))
         )
 
-    t = timed(s2, mk_vs(4, m))
-    print(f"s2 sorted seg_sum n/8 grps:  {t*1e3:9.2f} ms   "
-          f"{m * 8 / t / 1e9:8.1f} GB/s")
+        @jax.jit
+        def s3(x):
+            return tbl[perm] * x
 
-    # --- s3: gather from a large table (RE coef gather shape) -------------
-    tbl = jax.device_put(
-        jnp.asarray(rng.standard_normal(m).astype(np.float32))
-    )
-
-    @jax.jit
-    def s3(x):
-        return tbl[perm] * x
-
-    t = timed(s3, mk_vs(4, m))
-    print(f"s3 perm gather [m]<-[m]:     {t*1e3:9.2f} ms   "
-          f"{m * 12 / t / 1e9:8.1f} GB/s")
+        report("s3 perm gather [m]<-[m]", timed(s3, mk_vs(4, m)), m * 12)
 
 
 if __name__ == "__main__":

@@ -183,6 +183,49 @@ def test_objective_gradient_with_windows_matches_plain(monkeypatch):
     )
 
 
+def test_hessian_diagonal_with_windows_matches_plain(monkeypatch):
+    """Variance path: windowed Σ d2·x² (incl. the shift binomial expansion)
+    must match the plain segment_sum lowering."""
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.normalization import NormalizationContext
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.types import SparseBatch
+
+    rng = np.random.default_rng(5)
+    n, k, d = 96, 5, 80
+    idx, val = _random_ell(rng, n, k, d)
+    labels = (rng.uniform(size=n) > 0.4).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32) * 0.2
+    shifts = 0.05 * rng.standard_normal(d).astype(np.float32)
+    shifts[0] = 0.0  # intercept column: factor 1, shift 0
+    factors = 1.0 + 0.1 * rng.uniform(size=d).astype(np.float32)
+    factors[0] = 1.0
+    norm = NormalizationContext(
+        factors=jnp.asarray(factors),
+        shifts=jnp.asarray(shifts),
+        intercept_index=0,
+    )
+
+    def batch(windows):
+        return SparseBatch(
+            indices=jnp.asarray(idx),
+            values=jnp.asarray(val),
+            labels=jnp.asarray(labels),
+            offsets=jnp.zeros((n,), jnp.float32),
+            weights=jnp.ones((n,), jnp.float32),
+            windows=windows,
+        )
+
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.3, normalization=norm)
+    monkeypatch.setenv("PHOTON_SPARSE_RMATVEC", "onehot")
+    d0 = obj.hessian_diagonal(jnp.asarray(w), batch(None))
+    windows = build_column_windows(idx, val, d, window=32)
+    d1 = obj.hessian_diagonal(jnp.asarray(w), batch(windows))
+    np.testing.assert_allclose(
+        np.asarray(d0), np.asarray(d1), rtol=1e-4, atol=1e-5
+    )
+
+
 def test_maybe_build_windows_policy(monkeypatch):
     rng = np.random.default_rng(3)
     idx, val = _random_ell(rng, 32, 4, 4096)
