@@ -290,6 +290,19 @@ def rmatvec_windows_pallas(
     return _combine(out_inst, windows, dim)
 
 
+def _env_int(name: str, default: int, *, lo: int, hi: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError as e:
+        raise ValueError(f"{name}={raw!r} is not an integer") from e
+    if not lo <= v <= hi:
+        raise ValueError(f"{name}={v} outside [{lo}, {hi}]")
+    return v
+
+
 def maybe_build_windows(
     indices: np.ndarray,
     values: np.ndarray,
@@ -307,7 +320,15 @@ def maybe_build_windows(
     if flag in ("1", "on", "always") or (
         jax.default_backend() == "tpu" and num_features >= 1024
     ):
-        return build_column_windows(indices, values, num_features)
+        # tuning knobs (kernel-shape tradeoff: wider windows → fewer grid
+        # steps but more one-hot compares; see PERF.md). Deliberately NOT
+        # named PHOTON_SPARSE_WINDOW: one dropped character from the on/off
+        # flag PHOTON_SPARSE_WINDOWS must not silently become a width of 1.
+        window = _env_int("PHOTON_SPARSE_WINDOW_WIDTH", 128, lo=8, hi=8192)
+        cap = _env_int("PHOTON_SPARSE_WINDOW_CAP", 4096, lo=64, hi=1 << 20)
+        return build_column_windows(
+            indices, values, num_features, window=window, instance_cap=cap
+        )
     return None
 
 

@@ -1,0 +1,68 @@
+"""Drive scripts/micro_sparse.py case-by-case on the TPU, safest first.
+
+Each case runs in its own killable subprocess with a timeout sized to its
+wedge risk; the unsorted-scatter case (r1) runs LAST and at reduced n so
+a pathological lowering cannot occupy the chip for long after the kill
+(a killed client's in-flight device program keeps running remotely).
+
+Usage: python scripts/run_micro_tpu.py [--n 20] [--window 128]
+Writes cumulative results to stderr as it goes.
+"""
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+
+#: (case, n_log2_override or None, timeout_s) — safest → riskiest
+PLAN = [
+    ("s3", None, 240),   # gather (riskless, answers the gather question)
+    ("m1", None, 240),   # ELL gather matvec
+    ("s2", None, 240),   # sorted grouped segment_sum
+    ("s1", None, 300),   # unique vs colliding permutation scatter
+    ("p1", None, 420),   # production Pallas kernel
+    ("r3", None, 420),   # XLA scan variant
+    ("r2", 17, 300),     # sorted segment_sum at reduced n
+    ("r1", 15, 240),     # unsorted segment_sum, SMALL n (wedge risk)
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=20)
+    ap.add_argument("--d", type=int, default=20)
+    ap.add_argument("--k", type=int, default=56)
+    ap.add_argument("--window", type=int, default=128)
+    args = ap.parse_args()
+
+    for case, n_over, timeout_s in PLAN:
+        n = n_over if n_over is not None else args.n
+        cmd = [
+            sys.executable, "scripts/micro_sparse.py",
+            "--n", str(n), "--d", str(args.d), "--k", str(args.k),
+            "--window", str(args.window), "--only", case,
+        ]
+        print(f"=== {case} (n=2^{n}, timeout {timeout_s}s) ===",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=timeout_s
+            )
+            took = time.perf_counter() - t0
+            for line in (out.stdout or "").splitlines():
+                print(f"  {line}", file=sys.stderr, flush=True)
+            if out.returncode != 0:
+                tail = (out.stderr or "").strip().splitlines()[-2:]
+                print(f"  rc={out.returncode} {tail}", file=sys.stderr,
+                      flush=True)
+            print(f"  [{took:.0f}s]", file=sys.stderr, flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"  TIMEOUT >{timeout_s}s (killed — device program may "
+                  "linger; later cases will show it)", file=sys.stderr,
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
