@@ -133,7 +133,7 @@ class FixedEffectCoordinate(Coordinate):
                 weights=np.asarray(weights, dtype=dtype),
                 windows=maybe_build_windows(
                     ell_idx, ell_val, shard.num_cols,
-                    sharded=mesh is not None,
+                    host=mesh is not None,
                 ),
             )
         else:
@@ -150,8 +150,18 @@ class FixedEffectCoordinate(Coordinate):
             # Rows over every mesh device; in-jit gradient reductions become
             # psum over ICI (the reference's treeAggregate, SURVEY §5.8).
             # device_put straight from host numpy so no single device ever
-            # holds the whole [N, D] block.
+            # holds the whole [N, D] block. Column windows shard EXPLICITLY
+            # on the instance axis (shard_batch drops them — GSPMD cannot
+            # partition the scan/Pallas variants); the objective then runs
+            # the shard_map reduction in parallel/sparse.py.
+            windows = getattr(batch, "windows", None)
             batch = shard_batch(batch, mesh)
+            if windows is not None:
+                from photon_tpu.parallel.sparse import shard_windows
+
+                batch = batch._replace(
+                    windows=shard_windows(windows, mesh, shard.num_cols)
+                )
         else:
             # preserve integer leaves (sparse ELL indices) and an explicit
             # bfloat16 feature block as-is; leaves already on device (the
@@ -170,6 +180,7 @@ class FixedEffectCoordinate(Coordinate):
                 config.regularization_weights[0]
             ),
             normalization,
+            mesh=mesh if getattr(batch, "windows", None) is not None else None,
         )
         return FixedEffectCoordinate(
             config=config,
@@ -190,6 +201,7 @@ class FixedEffectCoordinate(Coordinate):
         self.problem = GLMProblem.build(
             self.config.optimization.with_regularization_weight(w),
             self.normalization,
+            mesh=self.problem.objective.mesh,  # keep the sharded backward
         )
         return self
 

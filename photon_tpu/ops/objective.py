@@ -60,7 +60,7 @@ def matvec(batch, v: Array) -> Array:
     return x @ v
 
 
-def rmatvec(batch, per_row: Array, dim: int) -> Array:
+def rmatvec(batch, per_row: Array, dim: int, mesh=None) -> Array:
     """Xᵀ·per_row for either batch layout (``dim`` = static feature count,
     always taken from the coefficient vector's shape).
 
@@ -83,6 +83,16 @@ def rmatvec(batch, per_row: Array, dim: int) -> Array:
             and impl != "segment"
         )
         if use_windows:
+            if mesh is not None:
+                # instance-sharded multi-chip reduction (parallel/sparse.py):
+                # per-shard kernel over its column ranges + one psum
+                from photon_tpu.parallel.sparse import (
+                    sharded_windowed_rmatvec,
+                )
+
+                return sharded_windowed_rmatvec(
+                    batch.windows, per_row, dim, mesh
+                )
             from photon_tpu.ops.sparse_windows import windowed_rmatvec
 
             return windowed_rmatvec(batch.windows, per_row, dim)
@@ -115,6 +125,9 @@ class GLMObjective:
     l2_weight: float = 0.0
     l1_weight: float = 0.0
     normalization: NormalizationContext = NormalizationContext()
+    #: set for multi-chip solves over window-carrying sparse batches — the
+    #: backward pass then uses the instance-sharded shard_map reduction
+    mesh: object = None
 
     # --- margins ----------------------------------------------------------
 
@@ -133,7 +146,7 @@ class GLMObjective:
         keeps the sparse path sparse (reference
         ValueAndGradientAggregator.scala:36-80).
         """
-        g = rmatvec(batch, per_row, dim)
+        g = rmatvec(batch, per_row, dim, mesh=self.mesh)
         if self.normalization.shifts is not None:
             g = g - jnp.sum(per_row) * self.normalization.shifts
         if self.normalization.factors is not None:
@@ -222,14 +235,24 @@ class GLMObjective:
             if windows is not None and d2.ndim == 1:
                 # same scatter-cliff reroute as rmatvec: Σᵢ d2ᵢ·xᵢⱼ² is a
                 # windowed Xᵀ·d2 with squared stored values
-                from photon_tpu.ops.sparse_windows import windowed_rmatvec
+                if self.mesh is not None:
+                    from photon_tpu.parallel.sparse import (
+                        sharded_windowed_rmatvec as _wrm,
+                    )
+
+                    def wrm(w_, r_, d_):
+                        return _wrm(w_, r_, d_, self.mesh)
+                else:
+                    from photon_tpu.ops.sparse_windows import (
+                        windowed_rmatvec as wrm,
+                    )
 
                 sq_windows = windows._replace(
                     vals=jnp.square(windows.vals)
                 )
-                sq = windowed_rmatvec(sq_windows, d2, dim)
+                sq = wrm(sq_windows, d2, dim)
                 if self.normalization.shifts is not None:
-                    lin = windowed_rmatvec(windows, d2, dim)
+                    lin = wrm(windows, d2, dim)
                     shifts = self.normalization.shifts
                     sq = (
                         sq

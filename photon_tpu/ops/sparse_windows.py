@@ -33,10 +33,12 @@ Instance partials combine with one [W_inst, w] → [W, w] sorted
 segment-sum (thousands of rows, not millions — off the cliff).
 
 Sharded batches (parallel/mesh.shard_batch) intentionally drop the
-windows: under row-sharding each shard's partial gradient is a *replicated*
-[D] psum operand and the per-shard scatter is back on the segment_sum
-path; multi-chip high-dim shards should shard the window axis instead —
-future work, single-chip is where config 3 runs today.
+windows: under plain GSPMD row-sharding the scan/Pallas variants do not
+partition, and the per-shard scatter is back on the segment_sum path.
+The multi-chip windowed path lives in ``parallel/sparse.py`` instead —
+window instances sharded explicitly over the mesh with ``shard_map``
+(column-range partials + one psum), reusing this module's kernels
+per shard.
 
 Selection: ``PHOTON_SPARSE_RMATVEC`` = auto (default) | pallas | onehot |
 flat | segment. AUTO → pallas on TPU, onehot elsewhere.
@@ -88,13 +90,16 @@ def build_column_windows(
     window: int = 128,
     instance_cap: int = 4096,
     chunk: int = 1024,
+    host: bool = False,
 ) -> ColumnWindows:
     """Host-side build from padded-ELL [N, K] arrays (vectorized numpy).
 
     ``instance_cap`` bounds L so one hot column (intercept!) spills across
     instances instead of inflating every window's padding. L is rounded up
     to a multiple of ``chunk`` (the kernel's VMEM one-hot chunk) or to 8
-    for small layouts.
+    for small layouts. ``host=True`` keeps the result as numpy — for mesh
+    placement, where materializing the whole stream on one device first
+    would be the exact single-device footprint the sharding avoids.
     """
     flat_col = np.asarray(indices).reshape(-1).astype(np.int64)
     flat_val = np.asarray(values).reshape(-1)  # dtype preserved (f64 stays f64)
@@ -144,12 +149,13 @@ def build_column_windows(
     inst2win = np.repeat(
         np.arange(num_windows, dtype=np.int32), n_inst
     )
+    wrap = (lambda x: x) if host else jnp.asarray
     return ColumnWindows(
-        rows=jnp.asarray(rows.reshape(w_inst, length)),
-        lcols=jnp.asarray(lcols.reshape(w_inst, length)),
-        vals=jnp.asarray(vals.reshape(w_inst, length)),
-        inst2win=jnp.asarray(inst2win),
-        iota=jnp.arange(window, dtype=jnp.int32),
+        rows=wrap(rows.reshape(w_inst, length)),
+        lcols=wrap(lcols.reshape(w_inst, length)),
+        vals=wrap(vals.reshape(w_inst, length)),
+        inst2win=wrap(inst2win),
+        iota=wrap(np.arange(window, dtype=np.int32)),
     )
 
 
@@ -308,14 +314,16 @@ def maybe_build_windows(
     values: np.ndarray,
     num_features: int,
     *,
-    sharded: bool = False,
+    host: bool = False,
 ):
     """Policy gate for the layout build: windows are worth their host-side
     sort + ~1.5× extra device memory only on TPU (where the scatter cliff
-    exists) at high dim, and never for sharded batches (see module
-    docstring). ``PHOTON_SPARSE_WINDOWS`` = auto (default) | 1 | 0."""
+    exists) at high dim. ``PHOTON_SPARSE_WINDOWS`` = auto (default) | 1 | 0.
+    Pass ``host=True`` when the result will be mesh-sharded
+    (parallel/sparse.shard_windows) so the stream never lands whole on one
+    device."""
     flag = os.environ.get("PHOTON_SPARSE_WINDOWS", "auto").strip().lower()
-    if sharded or flag in ("0", "off", "never"):
+    if flag in ("0", "off", "never"):
         return None
     if flag in ("1", "on", "always") or (
         jax.default_backend() == "tpu" and num_features >= 1024
@@ -327,7 +335,12 @@ def maybe_build_windows(
         window = _env_int("PHOTON_SPARSE_WINDOW_WIDTH", 128, lo=8, hi=8192)
         cap = _env_int("PHOTON_SPARSE_WINDOW_CAP", 4096, lo=64, hi=1 << 20)
         return build_column_windows(
-            indices, values, num_features, window=window, instance_cap=cap
+            indices,
+            values,
+            num_features,
+            window=window,
+            instance_cap=cap,
+            host=host,
         )
     return None
 

@@ -116,6 +116,7 @@ class GLMProblem:
     def build(
         config: GLMProblemConfig,
         normalization: NormalizationContext = NormalizationContext(),
+        mesh=None,
     ) -> "GLMProblem":
         loss = loss_for_task(config.task)
         if config.optimizer == OptimizerType.TRON and not loss.twice_diff:
@@ -131,7 +132,11 @@ class GLMProblem:
         ):
             raise ValueError("L1/elastic-net requires OWLQN")
         objective = GLMObjective(
-            loss=loss, l2_weight=l2, l1_weight=l1, normalization=normalization
+            loss=loss,
+            l2_weight=l2,
+            l1_weight=l1,
+            normalization=normalization,
+            mesh=mesh,
         )
         return GLMProblem(config=config, objective=objective)
 

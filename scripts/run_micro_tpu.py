@@ -11,9 +11,14 @@ Writes cumulative results to stderr as it goes.
 from __future__ import annotations
 
 import argparse
+import os
 import subprocess
 import sys
 import time
+
+_MICRO = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "micro_sparse.py"
+)
 
 #: (case, n_log2_override or None, timeout_s) — safest → riskiest
 PLAN = [
@@ -39,7 +44,7 @@ def main():
     for case, n_over, timeout_s in PLAN:
         n = n_over if n_over is not None else args.n
         cmd = [
-            sys.executable, "scripts/micro_sparse.py",
+            sys.executable, _MICRO,
             "--n", str(n), "--d", str(args.d), "--k", str(args.k),
             "--window", str(args.window), "--only", case,
         ]
@@ -54,9 +59,9 @@ def main():
             for line in (out.stdout or "").splitlines():
                 print(f"  {line}", file=sys.stderr, flush=True)
             if out.returncode != 0:
-                tail = (out.stderr or "").strip().splitlines()[-2:]
-                print(f"  rc={out.returncode} {tail}", file=sys.stderr,
-                      flush=True)
+                print(f"  rc={out.returncode}", file=sys.stderr, flush=True)
+                for ln in (out.stderr or "").strip().splitlines()[-4:]:
+                    print(f"  ! {ln}", file=sys.stderr, flush=True)
             print(f"  [{took:.0f}s]", file=sys.stderr, flush=True)
         except subprocess.TimeoutExpired:
             print(f"  TIMEOUT >{timeout_s}s (killed — device program may "
