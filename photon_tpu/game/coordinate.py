@@ -122,7 +122,11 @@ class FixedEffectCoordinate(Coordinate):
         if _use_sparse(
             config.representation, shard, dtype, config.bf16_features
         ):
-            ell_idx, ell_val = shard.to_ell(dtype=dtype)
+            # bf16 value storage halves the dominant HBM stream (indices
+            # stay int32); products/accumulation promote to f32 on read,
+            # matching the dense bf16 path's f32-accumulation contract
+            ell_dtype = jnp.bfloat16 if config.bf16_features else dtype
+            ell_idx, ell_val = shard.to_ell(dtype=np.dtype(ell_dtype))
             from photon_tpu.ops.sparse_windows import maybe_build_windows
 
             batch = SparseBatch(

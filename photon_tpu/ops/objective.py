@@ -60,6 +60,19 @@ def matvec(batch, v: Array) -> Array:
     return x @ v
 
 
+def _windowed_rmatvec_dispatch(windows, per_row: Array, dim: int, mesh):
+    """One routing decision for every windowed Xᵀ· reduction (gradient AND
+    variance paths): instance-sharded shard_map under a mesh, the
+    single-chip kernel otherwise."""
+    if mesh is not None:
+        from photon_tpu.parallel.sparse import sharded_windowed_rmatvec
+
+        return sharded_windowed_rmatvec(windows, per_row, dim, mesh)
+    from photon_tpu.ops.sparse_windows import windowed_rmatvec
+
+    return windowed_rmatvec(windows, per_row, dim)
+
+
 def rmatvec(batch, per_row: Array, dim: int, mesh=None) -> Array:
     """Xᵀ·per_row for either batch layout (``dim`` = static feature count,
     always taken from the coefficient vector's shape).
@@ -83,19 +96,9 @@ def rmatvec(batch, per_row: Array, dim: int, mesh=None) -> Array:
             and impl != "segment"
         )
         if use_windows:
-            if mesh is not None:
-                # instance-sharded multi-chip reduction (parallel/sparse.py):
-                # per-shard kernel over its column ranges + one psum
-                from photon_tpu.parallel.sparse import (
-                    sharded_windowed_rmatvec,
-                )
-
-                return sharded_windowed_rmatvec(
-                    batch.windows, per_row, dim, mesh
-                )
-            from photon_tpu.ops.sparse_windows import windowed_rmatvec
-
-            return windowed_rmatvec(batch.windows, per_row, dim)
+            return _windowed_rmatvec_dispatch(
+                batch.windows, per_row, dim, mesh
+            )
         flat = (batch.values * per_row[:, None]).reshape(-1)
         return jax.ops.segment_sum(
             flat, batch.indices.reshape(-1), num_segments=dim
@@ -235,24 +238,16 @@ class GLMObjective:
             if windows is not None and d2.ndim == 1:
                 # same scatter-cliff reroute as rmatvec: Σᵢ d2ᵢ·xᵢⱼ² is a
                 # windowed Xᵀ·d2 with squared stored values
-                if self.mesh is not None:
-                    from photon_tpu.parallel.sparse import (
-                        sharded_windowed_rmatvec as _wrm,
-                    )
-
-                    def wrm(w_, r_, d_):
-                        return _wrm(w_, r_, d_, self.mesh)
-                else:
-                    from photon_tpu.ops.sparse_windows import (
-                        windowed_rmatvec as wrm,
-                    )
-
                 sq_windows = windows._replace(
                     vals=jnp.square(windows.vals)
                 )
-                sq = wrm(sq_windows, d2, dim)
+                sq = _windowed_rmatvec_dispatch(
+                    sq_windows, d2, dim, self.mesh
+                )
                 if self.normalization.shifts is not None:
-                    lin = wrm(windows, d2, dim)
+                    lin = _windowed_rmatvec_dispatch(
+                        windows, d2, dim, self.mesh
+                    )
                     shifts = self.normalization.shifts
                     sq = (
                         sq

@@ -29,7 +29,6 @@ invariant of the flat variant.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -51,10 +50,10 @@ def pad_windows_for_mesh(
     num_windows = max(1, -(-num_features // w))
 
     def pad_leaf(x, fill):
+        # stays HOST numpy: device_put shards straight from host, so the
+        # padded stream never lands whole on one device
         widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-        return jnp.asarray(
-            np.pad(np.asarray(x), widths, constant_values=fill)
-        )
+        return np.pad(np.asarray(x), widths, constant_values=fill)
 
     return ColumnWindows(
         rows=pad_leaf(windows.rows, 0),

@@ -226,6 +226,62 @@ def test_hessian_diagonal_with_windows_matches_plain(monkeypatch):
     )
 
 
+def test_bf16_sparse_values_end_to_end(monkeypatch):
+    """bf16-stored sparse values (config.bf16_features on a sparse shard)
+    train close to the f32 path; windows preserve the bf16 storage."""
+    from photon_tpu.game.config import (
+        FeatureRepresentation,
+        FixedEffectCoordinateConfig,
+    )
+    from photon_tpu.game.coordinate import FixedEffectCoordinate
+    from photon_tpu.game.data import CSRMatrix, GameData
+    from photon_tpu.optimize.common import OptimizerConfig
+    from photon_tpu.optimize.problem import GLMProblemConfig
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(12)
+    n, d, k = 256, 1200, 5
+    cols = rng.integers(1, d, size=(n, k))
+    cols[:, 0] = 0
+    vals = rng.standard_normal((n, k)) / np.sqrt(k)
+    shard = CSRMatrix(
+        indptr=np.arange(n + 1, dtype=np.int64) * k,
+        indices=cols.reshape(-1).astype(np.int32),
+        values=vals.reshape(-1),
+        num_cols=d,
+    )
+    labels = (rng.uniform(size=n) > 0.5).astype(np.float64)
+    data = GameData.build(labels=labels, feature_shards={"g": shard})
+    monkeypatch.setenv("PHOTON_SPARSE_WINDOWS", "1")
+    monkeypatch.setenv("PHOTON_SPARSE_RMATVEC", "onehot")
+
+    def train(bf16):
+        cfg = FixedEffectCoordinateConfig(
+            feature_shard="g",
+            representation=FeatureRepresentation.SPARSE,
+            bf16_features=bf16,
+            optimization=GLMProblemConfig(
+                task=TaskType.LOGISTIC_REGRESSION,
+                optimizer_config=OptimizerConfig(
+                    max_iterations=10, ls_max_iterations=6
+                ),
+            ),
+            regularization_weights=(1.0,),
+        )
+        coord = FixedEffectCoordinate.build(data, cfg)
+        if bf16:
+            assert coord.batch.values.dtype == jnp.bfloat16
+            assert coord.batch.windows is not None
+            assert coord.batch.windows.vals.dtype == jnp.bfloat16
+        state, _ = coord.train(
+            jnp.zeros((n,), jnp.float32), coord.initial_state()
+        )
+        return np.asarray(state, np.float32)
+
+    w32, w16 = train(False), train(True)
+    assert np.linalg.norm(w16 - w32) / max(np.linalg.norm(w32), 1e-9) < 0.05
+
+
 def test_maybe_build_windows_policy(monkeypatch):
     rng = np.random.default_rng(3)
     idx, val = _random_ell(rng, 32, 4, 4096)
