@@ -1,0 +1,34 @@
+#!/bin/bash
+# Demonstrates the legacy staged GLM driver from the command line — the
+# photon-tpu counterpart of the reference's examples/run_photon_ml_driver.sh
+# (a1a LIBSVM logistic demo, reference README.md:206-259): where that script
+# assembles a spark-submit invocation, here the driver is a plain process
+# (the "cluster" is the XLA device mesh, not a YARN allocation).
+#
+# Usage: examples/run_photon_tpu_driver.sh <working_dir>
+#   expects <working_dir>/input/train and <working_dir>/input/test in
+#   LIBSVM format (e.g. the a1a dataset); writes models, metrics and an
+#   HTML diagnostic report under <working_dir>/results.
+#
+# On a machine without a TPU: JAX_PLATFORMS=cpu examples/run_photon_tpu_driver.sh ...
+set -euo pipefail
+
+WORK="${1:?usage: $0 <working_dir>}"
+
+python -m photon_tpu.cli.legacy_driver \
+  --training-data-dir "$WORK/input/train" \
+  --validating-data-dir "$WORK/input/test" \
+  --input-format LIBSVM \
+  --task LOGISTIC_REGRESSION \
+  --optimizer LBFGS \
+  --regularization-type L2 \
+  --regularization-weights 0.1,1,10,100 \
+  --max-num-iterations 100 \
+  --tolerance 1e-7 \
+  --normalization-type STANDARDIZATION \
+  --output-dir "$WORK/results" \
+  --override-output-directory \
+  --diagnose
+
+echo "metrics:"
+cat "$WORK/results/metrics.json"
