@@ -325,6 +325,12 @@ def maybe_build_windows(
     flag = os.environ.get("PHOTON_SPARSE_WINDOWS", "auto").strip().lower()
     if flag in ("0", "off", "never"):
         return None
+    if jax.process_count() > 1:
+        # multi-controller placement of the instance-sharded layout needs a
+        # make_array_from_callback path (parallel/sparse.shard_windows uses
+        # single-controller device_put); until that exists the sharded ELL
+        # segment_sum path is the multi-host story
+        return None
     if flag in ("1", "on", "always") or (
         jax.default_backend() == "tpu" and num_features >= 1024
     ):
