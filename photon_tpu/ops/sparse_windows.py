@@ -82,6 +82,75 @@ class ColumnWindows(NamedTuple):
         return self.rows.shape[1]
 
 
+def _native_histogram(arr_idx, arr_val, num_features):
+    """Per-column nonzero histogram via the C++ counting-sort builder
+    (native/window_builder.cpp) — O(nnz + d) vs numpy's comparison argsort.
+    Returns (col_counts, nnz) or None when the fast path does not apply
+    (non-f32 values, library unavailable)."""
+    if os.environ.get("PHOTON_NATIVE_WINDOWS", "1").strip().lower() in (
+        "0",
+        "off",
+        "never",
+    ):
+        return None
+    if arr_val.dtype != np.float32 or arr_idx.size == 0:
+        return None
+    from photon_tpu.data.native_index import _load_native_lib
+
+    lib = _load_native_lib()
+    if lib is None or not hasattr(lib, "win_col_histogram"):
+        return None
+    import ctypes
+
+    lib.win_col_histogram.restype = ctypes.c_int64
+    col_counts = np.zeros(num_features, dtype=np.int64)
+    vals = np.ascontiguousarray(arr_val, dtype=np.float32)
+    nnz = lib.win_col_histogram(
+        arr_idx.ctypes.data_as(ctypes.c_void_p),
+        vals.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(arr_idx.size),
+        ctypes.c_int64(num_features),
+        col_counts.ctypes.data_as(ctypes.c_void_p),
+    )
+    if nnz < 0:
+        raise ValueError("sparse column index outside [0, num_features)")
+    return col_counts, int(nnz), lib, vals
+
+
+def _native_fill(
+    lib, arr_idx, arr_val32, k, num_features, window, cap, length,
+    col_counts, win_start, inst_base, rows, lcols, vals,
+):
+    import ctypes
+
+    lib.win_fill.restype = ctypes.c_int64
+    col_next = np.concatenate([[0], np.cumsum(col_counts)])[:-1].astype(
+        np.int64
+    )
+    rc = lib.win_fill(
+        arr_idx.ctypes.data_as(ctypes.c_void_p),
+        arr_val32.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(arr_idx.size),
+        ctypes.c_int64(k),
+        ctypes.c_int64(num_features),
+        ctypes.c_int64(window),
+        ctypes.c_int64(cap),
+        ctypes.c_int64(length),
+        col_next.ctypes.data_as(ctypes.c_void_p),
+        np.ascontiguousarray(win_start, dtype=np.int64).ctypes.data_as(
+            ctypes.c_void_p
+        ),
+        np.ascontiguousarray(inst_base, dtype=np.int64).ctypes.data_as(
+            ctypes.c_void_p
+        ),
+        rows.ctypes.data_as(ctypes.c_void_p),
+        lcols.ctypes.data_as(ctypes.c_void_p),
+        vals.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise ValueError(f"native window fill failed rc={rc}")
+
+
 def build_column_windows(
     indices: np.ndarray,
     values: np.ndarray,
@@ -101,24 +170,31 @@ def build_column_windows(
     placement, where materializing the whole stream on one device first
     would be the exact single-device footprint the sharding avoids.
     """
-    flat_col = np.asarray(indices).reshape(-1).astype(np.int64)
-    flat_val = np.asarray(values).reshape(-1)  # dtype preserved (f64 stays f64)
-    n, k = np.asarray(indices).shape
-    flat_row = np.repeat(np.arange(n, dtype=np.int64), k)
-    keep = flat_val != 0.0  # ELL padding slots carry value 0
-    flat_col, flat_val, flat_row = (
-        flat_col[keep],
-        flat_val[keep],
-        flat_row[keep],
-    )
-    nnz = flat_col.size
+    arr_idx = np.ascontiguousarray(np.asarray(indices), dtype=np.int32)
+    arr_val = np.asarray(values)
+    n, k = arr_idx.shape
     num_windows = max(1, -(-num_features // window))
 
-    order = np.argsort(flat_col, kind="stable")
-    s_col, s_val, s_row = flat_col[order], flat_val[order], flat_row[order]
-    s_win = s_col // window
+    native = _native_histogram(arr_idx, arr_val, num_features)
+    if native is not None:
+        col_counts, nnz, nat_lib, nat_vals = native
+        counts = np.add.reduceat(
+            np.pad(col_counts, (0, num_windows * window - num_features)),
+            np.arange(num_windows) * window,
+        )
+    else:
+        flat_col = arr_idx.reshape(-1).astype(np.int64)
+        flat_val = arr_val.reshape(-1)
+        flat_row = np.repeat(np.arange(n, dtype=np.int64), k)
+        keep = flat_val != 0.0  # ELL padding slots carry value 0
+        flat_col, flat_val, flat_row = (
+            flat_col[keep],
+            flat_val[keep],
+            flat_row[keep],
+        )
+        nnz = flat_col.size
+        counts = np.bincount(flat_col // window, minlength=num_windows)
 
-    counts = np.bincount(s_win, minlength=num_windows)
     # Round the spill cap itself to the instance length so FULL spill
     # instances carry zero padding — mid-stream padding (local col w−1
     # between two instances of the same window) would break the sorted
@@ -132,19 +208,34 @@ def build_column_windows(
     n_inst = np.maximum(1, -(-counts // cap))
     w_inst = int(n_inst.sum())
     inst_base = np.concatenate([[0], np.cumsum(n_inst)])[:-1]
-
     win_start = np.concatenate([[0], np.cumsum(counts)])
-    pos_in_win = np.arange(nnz, dtype=np.int64) - win_start[s_win]
-    inst = inst_base[s_win] + pos_in_win // cap
-    pos = pos_in_win % cap
-    dest = inst * length + pos
 
     rows = np.zeros(w_inst * length, dtype=np.int32)
     lcols = np.full(w_inst * length, window - 1, dtype=np.int32)
-    vals = np.zeros(w_inst * length, dtype=flat_val.dtype)
-    rows[dest] = s_row
-    lcols[dest] = s_col % window
-    vals[dest] = s_val
+
+    if native is not None:
+        vals = np.zeros(w_inst * length, dtype=np.float32)
+        if nnz > 0:  # all-padding layout needs no fill pass
+            _native_fill(
+                nat_lib, arr_idx, nat_vals, k, num_features, window, cap,
+                length, col_counts, win_start, inst_base, rows, lcols, vals,
+            )
+    else:
+        vals = np.zeros(w_inst * length, dtype=flat_val.dtype)
+        order = np.argsort(flat_col, kind="stable")
+        s_col, s_val, s_row = (
+            flat_col[order],
+            flat_val[order],
+            flat_row[order],
+        )
+        s_win = s_col // window
+        pos_in_win = np.arange(nnz, dtype=np.int64) - win_start[s_win]
+        dest = (inst_base[s_win] + pos_in_win // cap) * length + (
+            pos_in_win % cap
+        )
+        rows[dest] = s_row
+        lcols[dest] = s_col % window
+        vals[dest] = s_val
 
     inst2win = np.repeat(
         np.arange(num_windows, dtype=np.int32), n_inst

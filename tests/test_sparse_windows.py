@@ -115,6 +115,23 @@ def test_float64_values_preserved():
     )
 
 
+def test_native_builder_matches_numpy(monkeypatch):
+    """The C++ counting-sort builder and the numpy argsort path must emit
+    byte-identical layouts (both are stable by column over slot order)."""
+    from photon_tpu.data.native_index import _load_native_lib
+
+    if _load_native_lib() is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(13)
+    n, k, d = 700, 6, 500
+    idx, val = _random_ell(rng, n, k, d, hot_column=True)
+    w_native = build_column_windows(idx, val, d, window=64, instance_cap=256)
+    monkeypatch.setenv("PHOTON_NATIVE_WINDOWS", "0")
+    w_numpy = build_column_windows(idx, val, d, window=64, instance_cap=256)
+    for a, b in zip(w_native, w_numpy):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_spill_layout_shape():
     """A column with N entries must spill across ⌈N/cap⌉ instances instead
     of inflating every window's padded length."""
