@@ -768,6 +768,21 @@ def _run_game_config(
             "device_bucket_bytes": int(dev_bytes),
         }
 
+    # full-model scoring + device grouped evaluation (per-entity AUC over
+    # every entity of the first RE coordinate — the MultiEvaluator lexsort/
+    # segment kernels at bench scale)
+    t0 = time.perf_counter()
+    scores = np.asarray(result.model.score(data))
+    score_wall = time.perf_counter() - t0
+    from photon_tpu.evaluation import MultiEvaluator
+
+    first_re = coords_spec[0][0]
+    t0 = time.perf_counter()
+    grouped_auc = MultiEvaluator.auc(first_re)(
+        scores, labels, np.asarray(id_tags[first_re])
+    )
+    grouped_wall = time.perf_counter() - t0
+
     # steady-state sweep time: tracker iterations >= 1 (iteration 0 pays
     # compiles); falls back to all iterations when only one ran
     it_rows = [r for r in result.tracker if "coordinate" in r]
@@ -788,6 +803,12 @@ def _run_game_config(
         "descent_iterations": descent_iterations,
         "data_build_s": round(data_build_s, 2),
         "fit_wall_s": round(fit_wall, 2),
+        "full_score_s": round(score_wall, 3),
+        "grouped_auc": {
+            "per": first_re,
+            "value": round(float(grouped_auc), 4),
+            "wall_s": round(grouped_wall, 3),
+        },
         "steady_sweep_s": round(steady_s, 4),
         "examples_per_sec": round(total_examples / steady_s, 1)
         if steady_s > 0
