@@ -329,9 +329,10 @@ def config_tron(peak_flops, scale):
             )
             return minimize_tron(
                 lambda w: obj.value_and_gradient(w, batch),
-                lambda w, v: obj.hessian_vector(w, v, batch),
+                None,
                 jnp.zeros((d,), dtype),
                 cfg,
+                hvp_factory=lambda w: obj.hessian_operator(w, batch),
             )
 
         return run

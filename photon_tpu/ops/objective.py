@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -182,15 +183,27 @@ class GLMObjective:
 
     def hessian_vector(self, coef: Array, v: Array, batch) -> Array:
         """H·v via one forward + one backward matmul (no O(D²) memory)."""
+        return self.hessian_operator(coef, batch)(v)
+
+    def hessian_operator(self, coef: Array, batch) -> Callable:
+        """H(coef)·v closure with the loss curvature precomputed.
+
+        The margin pass (one full read of the feature block) depends only
+        on the CENTER, not on v — TRON's truncated CG applies H·v up to 20
+        times per trust-region step at a fixed center (TRON.scala:278-339),
+        so hoisting it cuts each Hv from three feature passes to two.
+        """
         z = self.margins(coef, batch)
-        d2 = self.loss.d2(z, batch.labels)
-        xv = matvec(batch, self.normalization.effective_coefficients(v))
-        if self.normalization.shifts is not None:
-            xv = xv + self.normalization.margin_shift(v)
-        return (
-            self._back(batch.weights * d2 * xv, batch, coef.shape[-1])
-            + self.l2_weight * v
-        )
+        d2w = batch.weights * self.loss.d2(z, batch.labels)
+        dim = coef.shape[-1]
+
+        def hv(v: Array) -> Array:
+            xv = matvec(batch, self.normalization.effective_coefficients(v))
+            if self.normalization.shifts is not None:
+                xv = xv + self.normalization.margin_shift(v)
+            return self._back(d2w * xv, batch, dim) + self.l2_weight * v
+
+        return hv
 
     def hessian_matrix(self, coef: Array, batch) -> Array:
         """Dense D×D Hessian (used for coefficient variances on small D;

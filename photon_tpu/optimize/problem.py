@@ -196,9 +196,12 @@ class GLMProblem:
                 cfg = cfg.tron_defaults()
             return minimize_tron(
                 vg,
-                lambda w, v: objective.hessian_vector(w, v, batch),
+                None,
                 w0,
                 cfg,
+                # curvature hoisted out of the CG loop: one margin pass per
+                # trust-region step instead of per Hv
+                hvp_factory=lambda w: objective.hessian_operator(w, batch),
             )
         # LBFGS and LBFGSB (box bounds live in the OptimizerConfig)
         return minimize_lbfgs(vg, w0, cfg)

@@ -138,17 +138,35 @@ class _TronState(NamedTuple):
 
 def minimize_tron(
     value_and_grad: Callable[[Array], tuple[Array, Array]],
-    hvp: Callable[[Array, Array], Array],
+    hvp: Callable[[Array, Array], Array] | None,
     x0: Array,
     config: OptimizerConfig | None = None,
+    *,
+    hvp_factory: Callable[[Array], Callable[[Array], Array]] | None = None,
 ) -> OptimizeResult:
     """Minimize a twice-differentiable objective with trust-region Newton.
 
-    ``hvp(x, v)`` returns H(x)·v. Config defaults to the reference TRON
+    ``hvp(x, v)`` returns H(x)·v. ``hvp_factory(x)`` (preferred when the
+    curvature has reusable per-center state) returns an H(x)·v closure; it
+    is invoked ONCE per outer iteration, so a GLM's loss-curvature pass
+    (margins + d2 — one full read of the [N, D] block) is paid once per
+    trust-region step instead of once per CG iteration (the reference pays
+    it per Hv too: HessianVectorAggregator recomputes margins every call,
+    HessianVectorAggregator.scala:143-149 — up to 20 CG steps per outer
+    iteration, TRON.scala:278-339). Config defaults to the reference TRON
     envelope (maxIter=15, tol=1e-5, CG ≤ 20).
     """
     if config is None:
         config = OptimizerConfig().tron_defaults()
+    if hvp_factory is None:
+        if hvp is None:
+            raise ValueError("need hvp or hvp_factory")
+
+        def hvp_factory(x):
+            return lambda v: hvp(x, v)
+    elif hvp is not None:
+        # a silent winner would mask a curvature mismatch between the two
+        raise ValueError("pass hvp=None when hvp_factory is given")
     dtype = x0.dtype
     t = config.max_iterations
     has_box = config.lower_bounds is not None or config.upper_bounds is not None
@@ -184,7 +202,7 @@ def minimize_tron(
 
     def body(s: _TronState) -> _TronState:
         step, r, cg_iters = _truncated_cg(
-            lambda v: hvp(s.x, v),
+            hvp_factory(s.x),
             s.g,
             s.delta,
             max_iterations=config.max_cg_iterations,
