@@ -268,20 +268,25 @@ def config_a1a(peak_flops, scale):
             weights=jnp.ones((n,), dtype),
         )
         return minimize_lbfgs(
-            lambda w: obj.value_and_gradient(w, batch),
+            None,
             jnp.zeros((d,), dtype),
             cfg,
+            oracle=obj.directional_oracle(batch),  # production default path
         )
 
     res, wall = _timed_run(run, jax.random.PRNGKey(1))
     evals = int(res.n_evals)
-    flops = 4.0 * n * d * evals
+    # margin-space line search: trials are O(N) elementwise; feature-block
+    # passes are the honest FLOP unit (2·N·D flops per pass)
+    passes = int(res.n_feature_passes) or 2 * evals
+    flops = 2.0 * n * d * passes
     return {
         "n": n,
         "d": d,
         "wall_to_converge_s": round(wall, 4),
         "iterations": int(res.iterations),
         "n_evals": evals,
+        "n_feature_passes": passes,
         "converged_reason": int(res.reason),
         "examples_per_sec": round(n * evals / wall, 1),
         "analytic_flops": flops,
@@ -339,15 +344,19 @@ def config_tron(peak_flops, scale):
 
     def summarize(res, wall, feat_bytes):
         evals, hvp = int(res.n_evals), int(res.n_hvp)
-        flops = 4.0 * n * d * (evals + hvp)
-        # GLMs are memory-bound: report achieved HBM traffic too. Per
-        # eval/Hv the [N, D] block is read twice (forward + backward).
-        approx_bytes = 2.0 * feat_bytes * n * d * (evals + hvp)
+        # exact feature-block passes (incl. the once-per-outer-iteration
+        # curvature pass the hvp_factory hoists out of the CG loop)
+        passes = int(res.n_feature_passes) or 2 * (evals + hvp)
+        flops = 2.0 * n * d * passes
+        # GLMs are memory-bound: report achieved HBM traffic too (one
+        # [N, D] read per pass).
+        approx_bytes = feat_bytes * n * d * passes
         return {
             "wall_to_converge_s": round(wall, 4),
             "iterations": int(res.iterations),
             "n_evals": evals,
             "n_hvp": hvp,
+            "n_feature_passes": passes,
             "converged_reason": int(res.reason),
             "examples_per_sec": round(n * (evals + hvp) / wall, 1),
             "analytic_flops": flops,

@@ -63,6 +63,21 @@ class OptimizerConfig:
         return dataclasses.replace(self, max_iterations=15, tolerance=1e-5)
 
 
+class DirectionalOracle(NamedTuple):
+    """Objective interface for margin-space line searches (minimize_lbfgs).
+
+    ``full(x) -> (f, g, carry)`` — complete evaluation plus an opaque carry
+    (a GLM's margins) threaded through iterations.
+    ``dir_setup(carry, x, d) -> (phi, accept)`` — pay the per-direction
+    cost once; ``phi(alpha) -> (f, dphi, aux)`` is the cheap scalar oracle
+    for the Wolfe search, ``accept(alpha) -> (g, carry')`` produces the
+    accepted point's gradient and next carry.
+    """
+
+    full: object
+    dir_setup: object
+
+
 class OptimizeResult(NamedTuple):
     """Terminal optimizer state + per-iteration history (fixed shapes).
 
@@ -82,6 +97,12 @@ class OptimizeResult(NamedTuple):
     # objective (value+gradient) evaluations and Hessian-vector products.
     n_evals: Array | int = 0  # int32 scalar
     n_hvp: Array | int = 0  # int32 scalar
+    # Feature-block passes actually executed. With a margin-space line
+    # search (GLM directional oracle) trials are O(N) elementwise, so
+    # n_evals (trial count, reference-comparable) no longer implies
+    # 2 passes each; benches must use this for bytes/FLOP accounting.
+    # 0 ⇒ not tracked (older paths): assume 2·n_evals + 2·n_hvp.
+    n_feature_passes: Array | int = 0  # int32 scalar
 
     @property
     def converged(self) -> Array:

@@ -24,12 +24,16 @@ from jax import lax
 
 from photon_tpu.optimize.common import (
     ConvergenceReason,
+    DirectionalOracle,
     OptimizeResult,
     OptimizerConfig,
     convergence_check,
     project_to_box,
 )
-from photon_tpu.optimize.linesearch import wolfe_line_search
+from photon_tpu.optimize.linesearch import (
+    wolfe_line_search,
+    wolfe_search_phi,
+)
 from photon_tpu.types import Array
 
 _CURVATURE_EPS = 1e-10
@@ -50,6 +54,8 @@ class _LBFGSState(NamedTuple):
     loss_hist: Array
     gnorm_hist: Array
     n_evals: Array
+    n_passes: Array
+    carry: object  # DirectionalOracle state (GLM margins), () otherwise
 
 
 def two_loop_direction(
@@ -101,14 +107,23 @@ def two_loop_direction(
 
 
 def minimize_lbfgs(
-    value_and_grad: Callable[[Array], tuple[Array, Array]],
+    value_and_grad: Callable[[Array], tuple[Array, Array]] | None,
     x0: Array,
     config: OptimizerConfig = OptimizerConfig(),
+    *,
+    oracle: DirectionalOracle | None = None,
 ) -> OptimizeResult:
     """Minimize a smooth objective with L-BFGS.
 
     ``value_and_grad(x) -> (f, g)`` must be a pure jnp function. Returns an
     ``OptimizeResult`` pytree with fixed shapes (jit/vmap-stable).
+
+    ``oracle`` (a DirectionalOracle) switches the line search to the
+    margin-space form: trials cost O(N) elementwise on carried state
+    instead of full objective evaluations, and each iteration pays exactly
+    one forward (direction margins) + one backward (accepted gradient)
+    feature pass. ``n_evals`` still counts line-search trials (the
+    reference-comparable number); ``n_feature_passes`` counts real passes.
     """
     dtype = x0.dtype
     d = x0.shape[-1]
@@ -116,17 +131,30 @@ def minimize_lbfgs(
     t = config.max_iterations
     has_box = config.lower_bounds is not None or config.upper_bounds is not None
 
+    if oracle is None:
+        if value_and_grad is None:
+            raise ValueError("need value_and_grad or oracle")
+
+        def _full(x):
+            f, g = value_and_grad(x)
+            return f, g, ()
+
+        oracle = DirectionalOracle(full=_full, dir_setup=None)
+    elif value_and_grad is not None:
+        # a silent winner would mask an objective mismatch between the two
+        raise ValueError("pass value_and_grad=None when oracle is given")
+
     def eval_at(x):
-        f, g = value_and_grad(x)
-        return f.astype(dtype), g.astype(dtype)
+        f, g, carry = oracle.full(x)
+        return f.astype(dtype), g.astype(dtype), carry
 
     # Absolute tolerances from the zero-coefficient state (Optimizer.scala:181).
-    f_zero, g_zero = eval_at(jnp.zeros_like(x0))
+    f_zero, g_zero, _ = eval_at(jnp.zeros_like(x0))
     loss_abs_tol = jnp.abs(f_zero) * config.tolerance
     grad_abs_tol = jnp.linalg.norm(g_zero) * config.tolerance
 
     x_init = project_to_box(x0, config.lower_bounds, config.upper_bounds)
-    f0, g0 = eval_at(x_init)
+    f0, g0, carry0 = eval_at(x_init)
 
     init = _LBFGSState(
         it=jnp.zeros((), jnp.int32),
@@ -143,6 +171,8 @@ def minimize_lbfgs(
         loss_hist=jnp.full((t + 1,), f0, dtype),
         gnorm_hist=jnp.full((t + 1,), jnp.linalg.norm(g0), dtype),
         n_evals=jnp.asarray(2, jnp.int32),  # zero-state + initial point
+        n_passes=jnp.asarray(4, jnp.int32),  # 2 full evals x 2 passes
+        carry=carry0,
     )
 
     def cond(s: _LBFGSState):
@@ -163,25 +193,51 @@ def minimize_lbfgs(
             first, jnp.minimum(1.0, 1.0 / jnp.maximum(gnorm, 1e-12)), 1.0
         ).astype(dtype)
 
-        ls = wolfe_line_search(
-            eval_at,
-            s.x,
-            direction,
-            s.f,
-            s.g,
-            initial_step=init_step,
-            c1=config.ls_c1,
-            c2=config.ls_c2,
-            max_iterations=config.ls_max_iterations,
-        )
-
-        x_new, f_new, g_new = ls.x, ls.value, ls.gradient
-        n_evals = s.n_evals + ls.num_evals
+        if oracle.dir_setup is None:
+            ls = wolfe_line_search(
+                lambda x: eval_at(x)[:2],
+                s.x,
+                direction,
+                s.f,
+                s.g,
+                initial_step=init_step,
+                c1=config.ls_c1,
+                c2=config.ls_c2,
+                max_iterations=config.ls_max_iterations,
+            )
+            x_new, f_new, g_new = ls.x, ls.value, ls.gradient
+            carry_new = s.carry
+            num_trials = ls.num_evals
+            passes = 2 * ls.num_evals
+        else:
+            phi, accept = oracle.dir_setup(s.carry, s.x, direction)
+            res = wolfe_search_phi(
+                phi,
+                s.f,
+                jnp.dot(s.g, direction),
+                (),
+                dtype=dtype,
+                initial_step=init_step,
+                c1=config.ls_c1,
+                c2=config.ls_c2,
+                max_iterations=config.ls_max_iterations,
+            )
+            x_new = s.x + res.step * direction
+            f_new = res.value
+            g_new, carry_new = accept(res.step)
+            g_new = g_new.astype(dtype)
+            num_trials = res.num_evals
+            # one forward (direction margins) + one backward (gradient)
+            passes = jnp.asarray(2, jnp.int32)
+            ls = res  # for .success below
+        n_evals = s.n_evals + num_trials
+        n_passes = s.n_passes + passes
         if has_box:
             x_proj = project_to_box(x_new, config.lower_bounds, config.upper_bounds)
-            f_new, g_new = eval_at(x_proj)
+            f_new, g_new, carry_new = eval_at(x_proj)
             x_new = x_proj
             n_evals = n_evals + 1
+            n_passes = n_passes + 2
 
         step_failed = ~ls.success
 
@@ -231,6 +287,8 @@ def minimize_lbfgs(
             loss_hist=s.loss_hist.at[it].set(f_new),
             gnorm_hist=s.gnorm_hist.at[it].set(gnorm_new),
             n_evals=n_evals,
+            n_passes=n_passes,
+            carry=carry_new,
         )
 
     s = lax.while_loop(cond, body, init)
@@ -251,4 +309,5 @@ def minimize_lbfgs(
         grad_norm_history=gnorm_hist,
         n_evals=s.n_evals,
         n_hvp=jnp.zeros((), jnp.int32),
+        n_feature_passes=s.n_passes,
     )

@@ -6,6 +6,18 @@ per loop iteration; a bracketing stage expands the step until the minimum is
 bracketed, then a zoom stage shrinks the bracket with safeguarded quadratic
 interpolation. Runs entirely on device, so it vmaps across thousands of
 per-entity solves (each lane keeps its own bracket).
+
+Two entry points share the state machine:
+
+- ``wolfe_search_phi`` — the core, driven by a SCALAR oracle
+  ``phi(alpha) -> (value, directional_derivative, aux)``. The aux pytree
+  rides along so the caller gets back whatever it needs at the accepted
+  step (the full gradient for black-box objectives; nothing for GLM
+  margin-space searches, where each trial is O(N) elementwise on cached
+  margins instead of two feature-block passes — see
+  ops/objective.GLMObjective.directional_oracle).
+- ``wolfe_line_search`` — the black-box wrapper: phi evaluates
+  ``value_and_grad(x0 + alpha*direction)`` and aux carries the gradient.
 """
 from __future__ import annotations
 
@@ -24,6 +36,16 @@ class LineSearchResult(NamedTuple):
     value: Array
     gradient: Array
     success: Array  # bool: strong Wolfe satisfied (else best Armijo point)
+    num_evals: Array
+
+
+class PhiSearchResult(NamedTuple):
+    """Result of the scalar-oracle search (``wolfe_search_phi``)."""
+
+    step: Array
+    value: Array
+    aux: object  # pytree returned by phi at the accepted step
+    success: Array
     num_evals: Array
 
 
@@ -46,12 +68,12 @@ class _State(NamedTuple):
     # accepted point
     a_star: Array
     phi_star: Array
-    g_star: Array
+    aux_star: object
     success: Array
     # best Armijo-satisfying point seen (fallback)
     a_best: Array
     phi_best: Array
-    g_best: Array
+    aux_best: object
     has_best: Array
 
 
@@ -68,34 +90,32 @@ def _interp(a_lo, phi_lo, dphi_lo, a_hi, phi_hi):
     return jnp.where(bad, bisect, quad)
 
 
-def wolfe_line_search(
-    value_and_grad: Callable[[Array], tuple[Array, Array]],
-    x0: Array,
-    direction: Array,
+def _sel(cond, a, b):
+    """Elementwise pytree select."""
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(cond, x, y), a, b)
+
+
+def wolfe_search_phi(
+    phi: Callable[[Array], tuple[Array, Array, object]],
     f0: Array,
-    g0: Array,
+    dphi0: Array,
+    aux0: object,
     *,
+    dtype,
     initial_step: Array | float = 1.0,
     c1: float = 1e-4,
     c2: float = 0.9,
     max_iterations: int = 25,
     expansion: float = 2.0,
-) -> LineSearchResult:
-    """Find alpha satisfying the strong Wolfe conditions along ``direction``.
+) -> PhiSearchResult:
+    """Strong-Wolfe search on the scalar oracle ``phi``.
 
-    ``value_and_grad`` evaluates the full objective; directional derivatives
-    are dot products with ``direction``. On failure (no Wolfe point within the
-    evaluation budget) the best Armijo point seen is returned with
-    ``success=False``; if none exists, step 0 (no movement).
+    On failure (no Wolfe point within the evaluation budget) the best
+    Armijo point seen is returned with ``success=False``; if none exists,
+    step 0 (no movement, ``aux0`` returned).
     """
-    dtype = x0.dtype
-    dphi0 = jnp.dot(g0, direction).astype(dtype)
     f0 = f0.astype(dtype)
-
-    def phi(alpha):
-        f, g = value_and_grad(x0 + alpha * direction)
-        return f, g, jnp.dot(g, direction)
-
+    dphi0 = dphi0.astype(dtype)
     zero = jnp.zeros((), dtype)
 
     init = _State(
@@ -113,11 +133,11 @@ def wolfe_line_search(
         phi_hi=f0,
         a_star=zero,
         phi_star=f0,
-        g_star=g0,
+        aux_star=aux0,
         success=jnp.zeros((), bool),
         a_best=zero,
         phi_best=f0,
-        g_best=g0,
+        aux_best=aux0,
         has_best=jnp.zeros((), bool),
     )
 
@@ -129,7 +149,9 @@ def wolfe_line_search(
         alpha = jnp.where(
             in_zoom, _interp(s.a_lo, s.phi_lo, s.dphi_lo, s.a_hi, s.phi_hi), s.alpha
         )
-        f, g, dphi = phi(alpha)
+        f, dphi, aux = phi(alpha)
+        f = f.astype(dtype)
+        dphi = dphi.astype(dtype)
         armijo = f <= f0 + c1 * alpha * dphi0
         curv = jnp.abs(dphi) <= -c2 * dphi0
         wolfe = armijo & curv
@@ -138,7 +160,7 @@ def wolfe_line_search(
         better = armijo & ((~s.has_best) | (f < s.phi_best))
         a_best = jnp.where(better, alpha, s.a_best)
         phi_best = jnp.where(better, f, s.phi_best)
-        g_best = jnp.where(better, g, s.g_best)
+        aux_best = _sel(better, aux, s.aux_best)
         has_best = s.has_best | better
 
         # ---- bracketing stage transitions --------------------------------
@@ -196,11 +218,11 @@ def wolfe_line_search(
             ),
             a_star=jnp.where(star_now, alpha, s.a_star),
             phi_star=jnp.where(star_now, f, s.phi_star),
-            g_star=jnp.where(star_now, g, s.g_star),
+            aux_star=_sel(star_now, aux, s.aux_star),
             success=s.success | star_now,
             a_best=a_best,
             phi_best=phi_best,
-            g_best=g_best,
+            aux_best=aux_best,
             has_best=has_best,
         )
 
@@ -210,17 +232,63 @@ def wolfe_line_search(
     use_best = (~s.success) & s.has_best
     step = jnp.where(s.success, s.a_star, jnp.where(use_best, s.a_best, 0.0))
     value = jnp.where(s.success, s.phi_star, jnp.where(use_best, s.phi_best, f0))
-    grad = jax.tree_util.tree_map(
+    aux = jax.tree_util.tree_map(
         lambda a, b, c: jnp.where(s.success, a, jnp.where(use_best, b, c)),
-        s.g_star,
-        s.g_best,
-        g0,
+        s.aux_star,
+        s.aux_best,
+        aux0,
     )
-    return LineSearchResult(
+    return PhiSearchResult(
         step=step,
-        x=x0 + step * direction,
         value=value,
-        gradient=grad,
+        aux=aux,
         success=s.success | use_best,
         num_evals=s.i,
+    )
+
+
+def wolfe_line_search(
+    value_and_grad: Callable[[Array], tuple[Array, Array]],
+    x0: Array,
+    direction: Array,
+    f0: Array,
+    g0: Array,
+    *,
+    initial_step: Array | float = 1.0,
+    c1: float = 1e-4,
+    c2: float = 0.9,
+    max_iterations: int = 25,
+    expansion: float = 2.0,
+) -> LineSearchResult:
+    """Find alpha satisfying the strong Wolfe conditions along ``direction``.
+
+    Black-box form: each trial is a full ``value_and_grad`` evaluation; the
+    gradient rides through the search as the aux pytree so the accepted
+    point's gradient comes back without a re-evaluation.
+    """
+    dtype = x0.dtype
+
+    def phi(alpha):
+        f, g = value_and_grad(x0 + alpha * direction)
+        return f, jnp.dot(g, direction), g
+
+    res = wolfe_search_phi(
+        phi,
+        f0,
+        jnp.dot(g0, direction),
+        g0,
+        dtype=dtype,
+        initial_step=initial_step,
+        c1=c1,
+        c2=c2,
+        max_iterations=max_iterations,
+        expansion=expansion,
+    )
+    return LineSearchResult(
+        step=res.step,
+        x=x0 + res.step * direction,
+        value=res.value,
+        gradient=res.aux,
+        success=res.success,
+        num_evals=res.num_evals,
     )

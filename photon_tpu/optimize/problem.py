@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 from typing import Callable
 
 import jax
@@ -203,8 +204,20 @@ class GLMProblem:
                 # trust-region step instead of per Hv
                 hvp_factory=lambda w: objective.hessian_operator(w, batch),
             )
-        # LBFGS and LBFGSB (box bounds live in the OptimizerConfig)
-        return minimize_lbfgs(vg, w0, cfg)
+        # LBFGS and LBFGSB (box bounds live in the OptimizerConfig). The
+        # margin-space line search is the default — trials cost O(N)
+        # elementwise instead of two feature passes (biggest win inside the
+        # vmapped per-entity solves, where one straggler lane's trials used
+        # to cost every lane a feature pass). PHOTON_GLM_LINESEARCH=full
+        # forces the black-box search for A/B.
+        if (
+            os.environ.get("PHOTON_GLM_LINESEARCH", "margin").strip().lower()
+            == "full"
+        ):
+            return minimize_lbfgs(vg, w0, cfg)
+        return minimize_lbfgs(
+            None, w0, cfg, oracle=objective.directional_oracle(batch)
+        )
 
     # --- variances --------------------------------------------------------
 

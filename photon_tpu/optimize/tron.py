@@ -158,6 +158,7 @@ def minimize_tron(
     """
     if config is None:
         config = OptimizerConfig().tron_defaults()
+    factory_provided = hvp_factory is not None
     if hvp_factory is None:
         if hvp is None:
             raise ValueError("need hvp or hvp_factory")
@@ -300,4 +301,12 @@ def minimize_tron(
         grad_norm_history=gnorm_hist,
         n_evals=s.n_evals,
         n_hvp=s.n_hvp,
+        # with a GLM hvp_factory: 2 passes/eval + 2/Hv + the once-per-outer-
+        # iteration curvature pass the factory hoists out of the CG loop.
+        # Unknown for a black-box hvp (left 0 = "not tracked").
+        n_feature_passes=(
+            2 * s.n_evals + 2 * s.n_hvp + s.it
+            if factory_provided
+            else jnp.zeros((), jnp.int32)
+        ),
     )
