@@ -468,10 +468,11 @@ def config_sparse_poisson(peak_flops, scale):
         @jax.jit
         def run(batch, w0):
             return minimize_owlqn(
-                lambda w: obj.value_and_gradient(w, batch),
+                None,
                 w0,
                 l1,
                 run_cfg,
+                oracle=obj.smooth_margin_oracle(batch),  # production path
             )
 
         return run
@@ -549,10 +550,11 @@ def config_sparse_poisson(peak_flops, scale):
     jax.block_until_ready(res)
     wall = time.perf_counter() - t0
     evals = int(res.n_evals)
-    nnz_flops = 4.0 * n * k * evals
-    # gather+scatter traffic dominates: idx+val read twice per eval (margin
-    # gather + backward scatter) at 4+4 bytes per slot
-    approx_bytes = 2.0 * (4.0 + 4.0) * n * k * evals
+    # value-only trials: one (idx, val) stream pass per trial + one
+    # backward per iteration — exact from the pass counter
+    passes = int(res.n_feature_passes) or 2 * evals
+    nnz_flops = 2.0 * n * k * passes
+    approx_bytes = (4.0 + 4.0) * n * k * passes
     w_final = res.x
     sparsity = float(jnp.mean((w_final == 0).astype(jnp.float32)))
     return {
@@ -569,6 +571,7 @@ def config_sparse_poisson(peak_flops, scale):
         "wall_to_converge_s": round(wall, 4),
         "iterations": int(res.iterations),
         "n_evals": evals,
+        "n_feature_passes": passes,
         "converged_reason": int(res.reason),
         "examples_per_sec": round(n * evals / wall, 1),
         "analytic_flops": nnz_flops,

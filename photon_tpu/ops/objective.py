@@ -33,7 +33,10 @@ import jax.numpy as jnp
 
 from photon_tpu.ops.losses import PointwiseLoss
 from photon_tpu.ops.normalization import NormalizationContext
-from photon_tpu.optimize.common import DirectionalOracle
+from photon_tpu.optimize.common import (
+    DirectionalOracle,
+    SmoothMarginOracle,
+)
 from photon_tpu.types import Array, LabeledBatch, SparseBatch
 
 
@@ -245,6 +248,31 @@ class GLMObjective:
             return phi, accept
 
         return DirectionalOracle(full=full, dir_setup=dir_setup)
+
+    def smooth_margin_oracle(self, batch) -> SmoothMarginOracle:
+        """Value-only trial oracle for OWLQN (optimize/owlqn.py): each
+        backtracking trial pays one forward pass; the backward pass runs
+        once, on the accepted point's carried margins."""
+
+        def value_margins(x: Array):
+            z = self.margins(x, batch)
+            f = jnp.sum(
+                batch.weights * self.loss.loss(z, batch.labels)
+            ) + 0.5 * self.l2_weight * jnp.dot(x, x)
+            return f, z
+
+        def grad_from_margins(x: Array, z: Array):
+            _, d1 = self.loss.loss_and_d1(z, batch.labels)
+            return (
+                self._back(batch.weights * d1, batch, x.shape[-1])
+                + self.l2_weight * x
+            )
+
+        return SmoothMarginOracle(
+            full=lambda x: self._value_grad_margins(x, batch),
+            value_margins=value_margins,
+            grad_from_margins=grad_from_margins,
+        )
 
     def hessian_operator(self, coef: Array, batch) -> Callable:
         """H(coef)·v closure with the loss curvature precomputed.

@@ -78,6 +78,23 @@ class DirectionalOracle(NamedTuple):
     dir_setup: object
 
 
+class SmoothMarginOracle(NamedTuple):
+    """Objective interface for value-only line-search trials (OWLQN).
+
+    Orthant projection makes the trial point non-affine in the step, so
+    OWLQN cannot reuse the DirectionalOracle's cached-margins trick — but
+    its Armijo test needs only the VALUE. ``value_margins(x) -> (f, z)``
+    is one forward pass; ``grad_from_margins(x, z) -> g`` turns the
+    accepted trial's margins into the gradient with one backward pass —
+    trials drop from 2 feature passes to 1, and the gradient is paid once
+    per iteration. ``full(x) -> (f, g, z)`` for init/box re-evaluations.
+    """
+
+    full: object
+    value_margins: object
+    grad_from_margins: object
+
+
 class OptimizeResult(NamedTuple):
     """Terminal optimizer state + per-iteration history (fixed shapes).
 

@@ -224,11 +224,17 @@ def minimize_lbfgs(
             )
             x_new = s.x + res.step * direction
             f_new = res.value
-            g_new, carry_new = accept(res.step)
-            g_new = g_new.astype(dtype)
+            if has_box:
+                # the box path fully re-evaluates at the projected point
+                # below — don't pay accept()'s backward pass to discard it
+                g_new, carry_new = s.g, s.carry
+                passes = jnp.asarray(1, jnp.int32)  # direction margins
+            else:
+                g_new, carry_new = accept(res.step)
+                g_new = g_new.astype(dtype)
+                # one forward (direction margins) + one backward (gradient)
+                passes = jnp.asarray(2, jnp.int32)
             num_trials = res.num_evals
-            # one forward (direction margins) + one backward (gradient)
-            passes = jnp.asarray(2, jnp.int32)
             ls = res  # for .success below
         n_evals = s.n_evals + num_trials
         n_passes = s.n_passes + passes

@@ -21,6 +21,7 @@ from photon_tpu.optimize.common import (
     ConvergenceReason,
     OptimizeResult,
     OptimizerConfig,
+    SmoothMarginOracle,
     convergence_check,
     project_to_box,
 )
@@ -52,18 +53,41 @@ class _OWLQNState(NamedTuple):
     loss_hist: Array
     gnorm_hist: Array
     n_evals: Array
+    n_passes: Array
+    carry: object  # margins of the smooth part at x (oracle mode), else ()
 
 
 def minimize_owlqn(
-    value_and_grad: Callable[[Array], tuple[Array, Array]],
+    value_and_grad: Callable[[Array], tuple[Array, Array]] | None,
     x0: Array,
     l1_weight: float,
     config: OptimizerConfig = OptimizerConfig(),
+    *,
+    oracle: SmoothMarginOracle | None = None,
 ) -> OptimizeResult:
     """Minimize f(x) + l1_weight·‖x‖₁ where ``value_and_grad`` evaluates the
     smooth part f. Returns the reference-shaped ``OptimizeResult`` (the
-    ``gradient`` field holds the pseudo-gradient at the solution)."""
+    ``gradient`` field holds the pseudo-gradient at the solution).
+
+    With a ``SmoothMarginOracle`` each backtracking trial computes the
+    VALUE only (one feature pass — Armijo never needs the gradient) and
+    the accepted point's gradient comes from its carried margins with one
+    backward pass: trials+1 passes per iteration vs 2·trials black-box.
+    """
     dtype = x0.dtype
+    if oracle is not None and value_and_grad is not None:
+        raise ValueError("pass value_and_grad=None when oracle is given")
+    if oracle is None:
+        if value_and_grad is None:
+            raise ValueError("need value_and_grad or oracle")
+
+        def _full(x):
+            f, g = value_and_grad(x)
+            return f, g, ()
+
+        oracle = SmoothMarginOracle(
+            full=_full, value_margins=None, grad_from_margins=None
+        )
     d = x0.shape[-1]
     m = config.num_corrections
     t = config.max_iterations
@@ -73,19 +97,19 @@ def minimize_owlqn(
         x0 = project_to_box(x0, config.lower_bounds, config.upper_bounds)
 
     def eval_smooth(x):
-        f, g = value_and_grad(x)
-        return f.astype(dtype), g.astype(dtype)
+        f, g, carry = oracle.full(x)
+        return f.astype(dtype), g.astype(dtype), carry
 
     def full_value(f_smooth, x):
         return f_smooth + l1 * jnp.sum(jnp.abs(x))
 
     # Absolute tolerances off the zero state (reference Optimizer.scala:181).
-    f_zero, g_zero = eval_smooth(jnp.zeros_like(x0))
+    f_zero, g_zero, _ = eval_smooth(jnp.zeros_like(x0))
     pg_zero = pseudo_gradient(jnp.zeros_like(x0), g_zero, l1)
     loss_abs_tol = jnp.abs(f_zero) * config.tolerance
     grad_abs_tol = jnp.linalg.norm(pg_zero) * config.tolerance
 
-    f0s, g0 = eval_smooth(x0)
+    f0s, g0, carry0 = eval_smooth(x0)
     f0 = full_value(f0s, x0)
 
     init = _OWLQNState(
@@ -104,6 +128,8 @@ def minimize_owlqn(
             (t + 1,), jnp.linalg.norm(pseudo_gradient(x0, g0, l1)), dtype
         ),
         n_evals=jnp.asarray(2, jnp.int32),  # zero-state + initial point
+        n_passes=jnp.asarray(4, jnp.int32),
+        carry=carry0,
     )
 
     def cond(s: _OWLQNState):
@@ -137,50 +163,100 @@ def minimize_owlqn(
             i, step, done, *_ = carry
             return (~done) & (i < config.ls_max_iterations)
 
-        def ls_body(carry):
-            i, step, done, x_b, f_b, g_b, ok = carry
-            x_cand = project(s.x + step * direction)
-            f_s, g_cand = eval_smooth(x_cand)
-            f_cand = full_value(f_s, x_cand)
+        def _armijo(x_cand, f_cand):
             # Armijo on F with the directional derivative measured along the
             # *projected* displacement (Andrew & Gao eq. 4).
             dx = x_cand - s.x
             suff = f_cand <= s.f + config.ls_c1 * jnp.dot(pg, dx)
             moved = jnp.dot(dx, dx) > 0.0
-            accept = suff & moved
-            return (
-                i + 1,
-                step * 0.5,
-                done | accept,
-                jnp.where(accept, x_cand, x_b),
-                jnp.where(accept, f_cand, f_b),
-                jnp.where(accept, g_cand, g_b),
-                ok | accept,
-            )
+            return suff & moved
 
-        ls_iters, _, _, x_new, f_new, g_new, ls_ok = lax.while_loop(
-            ls_cond,
-            ls_body,
-            (
-                jnp.zeros((), jnp.int32),
-                init_step,
-                jnp.zeros((), bool),
-                s.x,
-                s.f,
-                s.g_smooth,
-                jnp.zeros((), bool),
-            ),
-        )
+        if oracle.value_margins is None:
+            def ls_body(carry):
+                i, step, done, x_b, f_b, g_b, ok = carry
+                x_cand = project(s.x + step * direction)
+                f_s, g_cand, _ = eval_smooth(x_cand)
+                f_cand = full_value(f_s, x_cand)
+                accept = _armijo(x_cand, f_cand)
+                return (
+                    i + 1,
+                    step * 0.5,
+                    done | accept,
+                    jnp.where(accept, x_cand, x_b),
+                    jnp.where(accept, f_cand, f_b),
+                    jnp.where(accept, g_cand, g_b),
+                    ok | accept,
+                )
+
+            ls_iters, _, _, x_new, f_new, g_new, ls_ok = lax.while_loop(
+                ls_cond,
+                ls_body,
+                (
+                    jnp.zeros((), jnp.int32),
+                    init_step,
+                    jnp.zeros((), bool),
+                    s.x,
+                    s.f,
+                    s.g_smooth,
+                    jnp.zeros((), bool),
+                ),
+            )
+            carry_new = s.carry
+            passes = 2 * ls_iters
+        else:
+            # value-only trials (1 pass each); margins ride the carry so the
+            # accepted gradient is one backward pass after the loop
+            def ls_body(carry):
+                i, step, done, x_b, f_b, z_b, ok = carry
+                x_cand = project(s.x + step * direction)
+                f_s, z_cand = oracle.value_margins(x_cand)
+                f_cand = full_value(f_s.astype(dtype), x_cand)
+                accept = _armijo(x_cand, f_cand)
+                z_b = jnp.where(accept, z_cand, z_b)
+                return (
+                    i + 1,
+                    step * 0.5,
+                    done | accept,
+                    jnp.where(accept, x_cand, x_b),
+                    jnp.where(accept, f_cand, f_b),
+                    z_b,
+                    ok | accept,
+                )
+
+            ls_iters, _, _, x_new, f_new, z_new, ls_ok = lax.while_loop(
+                ls_cond,
+                ls_body,
+                (
+                    jnp.zeros((), jnp.int32),
+                    init_step,
+                    jnp.zeros((), bool),
+                    s.x,
+                    s.f,
+                    s.carry,
+                    jnp.zeros((), bool),
+                ),
+            )
+            if has_box:
+                # the box path fully re-evaluates at the projected point —
+                # don't pay a backward pass only to discard it
+                g_new, carry_new = s.g_smooth, z_new
+                passes = ls_iters
+            else:
+                g_new = oracle.grad_from_margins(x_new, z_new).astype(dtype)
+                carry_new = z_new
+                passes = ls_iters + 1
+        n_passes = s.n_passes + passes
         if has_box:
             # box projection after every step, like the reference OWLQN
             # (constraintMap flows through the LBFGS base, LBFGS.scala:59-82)
             x_proj = project_to_box(
                 x_new, config.lower_bounds, config.upper_bounds
             )
-            f_s, g_new = eval_smooth(x_proj)
+            f_s, g_new, carry_new = eval_smooth(x_proj)
             f_new = full_value(f_s, x_proj)
             x_new = x_proj
             ls_iters = ls_iters + 1
+            n_passes = n_passes + 2
 
         # History update with smooth gradients.
         s_vec = x_new - s.x
@@ -226,6 +302,8 @@ def minimize_owlqn(
             loss_hist=s.loss_hist.at[it].set(f_new),
             gnorm_hist=s.gnorm_hist.at[it].set(pg_new_norm),
             n_evals=s.n_evals + ls_iters,
+            n_passes=n_passes,
+            carry=carry_new,
         )
 
     s = lax.while_loop(cond, body, init)
@@ -245,4 +323,5 @@ def minimize_owlqn(
         grad_norm_history=gnorm_hist,
         n_evals=s.n_evals,
         n_hvp=jnp.zeros((), jnp.int32),
+        n_feature_passes=s.n_passes,
     )

@@ -180,8 +180,22 @@ class GLMProblem:
             RegularizationType.L1,
             RegularizationType.ELASTIC_NET,
         )
+        full_ls = (
+            os.environ.get("PHOTON_GLM_LINESEARCH", "margin").strip().lower()
+            == "full"
+        )
         if has_l1 or opt == OptimizerType.OWLQN:
-            return minimize_owlqn(vg, w0, objective.l1_weight, cfg)
+            if full_ls:
+                return minimize_owlqn(vg, w0, objective.l1_weight, cfg)
+            # value-only backtracking trials (1 feature pass each) with the
+            # accepted gradient from carried margins
+            return minimize_owlqn(
+                None,
+                w0,
+                objective.l1_weight,
+                cfg,
+                oracle=objective.smooth_margin_oracle(batch),
+            )
         if opt == OptimizerType.TRON:
             # fully untouched config → switch to TRON's own defaults
             # (field-wise check excluding the bounds, which may be arrays —
@@ -210,10 +224,7 @@ class GLMProblem:
         # vmapped per-entity solves, where one straggler lane's trials used
         # to cost every lane a feature pass). PHOTON_GLM_LINESEARCH=full
         # forces the black-box search for A/B.
-        if (
-            os.environ.get("PHOTON_GLM_LINESEARCH", "margin").strip().lower()
-            == "full"
-        ):
+        if full_ls:
             return minimize_lbfgs(vg, w0, cfg)
         return minimize_lbfgs(
             None, w0, cfg, oracle=objective.directional_oracle(batch)

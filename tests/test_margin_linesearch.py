@@ -147,6 +147,43 @@ def test_oracle_with_box_constraints():
     assert float(res.value) == pytest.approx(float(res_full.value), rel=1e-4)
 
 
+def test_owlqn_value_only_trials_match_blackbox():
+    """OWLQN's SmoothMarginOracle (value-only trials, gradient from carried
+    margins) reproduces the black-box solve, including the sparsity
+    pattern, and tracks passes = trials + 1 per iteration."""
+    from photon_tpu.optimize import minimize_owlqn
+
+    rng = np.random.default_rng(5)
+    n, d = 500, 32
+    batch = _batch(rng, n, d)
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.05, l1_weight=0.1)
+    cfg = OptimizerConfig(max_iterations=50)
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    res_full = minimize_owlqn(
+        lambda w: obj.value_and_gradient(w, batch), w0, 0.1, cfg
+    )
+    res_m = minimize_owlqn(
+        None, w0, 0.1, cfg, oracle=obj.smooth_margin_oracle(batch)
+    )
+    assert float(res_m.value) == pytest.approx(
+        float(res_full.value), rel=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_m.x), np.asarray(res_full.x), rtol=5e-3, atol=5e-4
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_m.x) == 0.0, np.asarray(res_full.x) == 0.0
+    )
+    # value-only trials: passes strictly below the black-box 2-per-trial
+    assert int(res_m.n_feature_passes) == 4 + int(res_m.n_evals) - 2 + int(
+        res_m.iterations
+    )
+    assert int(res_full.n_feature_passes) == 4 + 2 * (
+        int(res_full.n_evals) - 2
+    )
+
+
 def test_oracle_sparse_batch_with_windows(monkeypatch):
     """Sparse FE solve: oracle margins via ELL gather, accepted gradient
     via the windowed backward."""
