@@ -184,6 +184,32 @@ def test_owlqn_value_only_trials_match_blackbox():
     )
 
 
+def test_owlqn_oracle_with_box_constraints():
+    from photon_tpu.optimize import minimize_owlqn
+
+    rng = np.random.default_rng(6)
+    batch = _batch(rng, 300, 12)
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.05, l1_weight=0.05)
+    lo, hi = jnp.full((12,), -0.04), jnp.full((12,), 0.04)
+    cfg = OptimizerConfig(max_iterations=30, lower_bounds=lo, upper_bounds=hi)
+    res = minimize_owlqn(
+        None,
+        jnp.zeros((12,)),
+        0.05,
+        cfg,
+        oracle=obj.smooth_margin_oracle(batch),
+    )
+    x = np.asarray(res.x)
+    assert np.all(x >= -0.04 - 1e-6) and np.all(x <= 0.04 + 1e-6)
+    ref = minimize_owlqn(
+        lambda w: obj.value_and_gradient(w, batch),
+        jnp.zeros((12,)),
+        0.05,
+        cfg,
+    )
+    assert float(res.value) == pytest.approx(float(ref.value), rel=1e-4)
+
+
 def test_oracle_sparse_batch_with_windows(monkeypatch):
     """Sparse FE solve: oracle margins via ELL gather, accepted gradient
     via the windowed backward."""
