@@ -101,8 +101,11 @@ CONFIG_PLAN = [
     ("a1a_logistic_lbfgs", 600, 3),
     ("linear_tron", 900, 3),
     ("sparse_poisson_owlqn", 1500, 2),
-    ("glmix_game_estimator", 1500, 2),
-    ("game_ctr_scale", 3000, 2),
+    # the GAME configs compile tens of programs (per-bucket RE solves);
+    # remote compiles through the relay are slow, so their budgets cover a
+    # cold cache — retries resume from the persistent compile cache
+    ("glmix_game_estimator", 2400, 2),
+    ("game_ctr_scale", 3600, 2),
 ]
 
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
