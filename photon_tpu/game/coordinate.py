@@ -272,6 +272,7 @@ class _DeviceBucket:
     weights: Array  # raw weights (scoring mask)
     train_weights: Array  # weights * active_mask
     sample_pos: Array  # [E, n] int32, ≥ num_samples ⇒ padding (unique)
+    pad_slots: int  # count of renumbered padding slots (static, build time)
     entity_ids: np.ndarray
     col_index: np.ndarray
 
@@ -323,6 +324,10 @@ class RandomEffectCoordinate(Coordinate):
                 widths = [(0, e_pad)] + [(0, 0)] * (x.ndim - 1)
                 return np.pad(x, widths, constant_values=fill)
 
+            sp_unique = _uniquify_padding(
+                pad_e(b.sample_pos, fill=dataset.num_samples),
+                dataset.num_samples,
+            )
             device_buckets.append(
                 _DeviceBucket(
                     features=put_entities(
@@ -342,15 +347,9 @@ class RandomEffectCoordinate(Coordinate):
                             pad_e(b.weights * b.active_mask), dtype=dtype
                         )
                     ),
-                    sample_pos=put_entities(
-                        jnp.asarray(
-                            _uniquify_padding(
-                                pad_e(
-                                    b.sample_pos, fill=dataset.num_samples
-                                ),
-                                dataset.num_samples,
-                            )
-                        )
+                    sample_pos=put_entities(jnp.asarray(sp_unique)),
+                    pad_slots=int(
+                        np.sum(sp_unique >= dataset.num_samples)
                     ),
                     entity_ids=b.entity_ids,
                     col_index=b.col_index,
@@ -429,17 +428,18 @@ class RandomEffectCoordinate(Coordinate):
             infos.append(res)
         return new_state, infos
 
-    @partial(jax.jit, static_argnums=(0,))
-    def _score_bucket(self, features, weights, sample_pos, coefs) -> Array:
+    @partial(jax.jit, static_argnums=(0, 5))
+    def _score_bucket(
+        self, features, weights, sample_pos, coefs, pad_slots
+    ) -> Array:
         s = jnp.einsum("end,ed->en", features, coefs)
         s = jnp.where(weights > 0, s, 0.0)
         # sample_pos slots are globally unique (padding slots were renumbered
         # past num_samples at device placement), so the scatter can promise
         # unique_indices — XLA:TPU's colliding-scatter lowering serializes,
-        # the unique path does not. The overflow tail is sliced off.
-        out = jnp.zeros(
-            (self.num_samples + sample_pos.size,), dtype=s.dtype
-        )
+        # the unique path does not. The overflow tail holds exactly the
+        # renumbered padding slots (static per bucket) and is sliced off.
+        out = jnp.zeros((self.num_samples + pad_slots,), dtype=s.dtype)
         out = out.at[sample_pos.reshape(-1)].add(
             s.reshape(-1), unique_indices=True
         )
@@ -449,7 +449,7 @@ class RandomEffectCoordinate(Coordinate):
         total = jnp.zeros((self.num_samples,), dtype=self.dtype)
         for db, coefs in zip(self.device_buckets, state):
             total = total + self._score_bucket(
-                db.features, db.weights, db.sample_pos, coefs
+                db.features, db.weights, db.sample_pos, coefs, db.pad_slots
             )
         return total
 

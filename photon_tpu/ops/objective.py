@@ -65,6 +65,19 @@ def matvec(batch, v: Array) -> Array:
     return x @ v
 
 
+def _use_windows(batch, per_row: Array) -> bool:
+    """Single routing decision for every windowed reduction (gradient AND
+    variance paths): a column-window layout is present, the reduction is a
+    plain 1-D row weighting, and ``PHOTON_SPARSE_RMATVEC=segment`` has not
+    forced the flat scatter path for A/B measurement."""
+    impl = os.environ.get("PHOTON_SPARSE_RMATVEC", "auto").strip().lower()
+    return (
+        getattr(batch, "windows", None) is not None
+        and per_row.ndim == 1
+        and impl != "segment"
+    )
+
+
 def _windowed_rmatvec_dispatch(windows, per_row: Array, dim: int, mesh):
     """One routing decision for every windowed Xᵀ· reduction (gradient AND
     variance paths): instance-sharded shard_map under a mesh, the
@@ -92,15 +105,7 @@ def rmatvec(batch, per_row: Array, dim: int, mesh=None) -> Array:
     forces the plain path for A/B measurement.
     """
     if isinstance(batch, SparseBatch):
-        impl = os.environ.get(
-            "PHOTON_SPARSE_RMATVEC", "auto"
-        ).strip().lower()
-        use_windows = (
-            getattr(batch, "windows", None) is not None
-            and per_row.ndim == 1
-            and impl != "segment"
-        )
-        if use_windows:
+        if _use_windows(batch, per_row):
             return _windowed_rmatvec_dispatch(
                 batch.windows, per_row, dim, mesh
             )
@@ -337,7 +342,7 @@ class GLMObjective:
         dim = coef.shape[-1]
         if isinstance(batch, SparseBatch):
             windows = getattr(batch, "windows", None)
-            if windows is not None and d2.ndim == 1:
+            if _use_windows(batch, d2):
                 # same scatter-cliff reroute as rmatvec: Σᵢ d2ᵢ·xᵢⱼ² is a
                 # windowed Xᵀ·d2 with squared stored values
                 sq_windows = windows._replace(

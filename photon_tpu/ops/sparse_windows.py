@@ -361,9 +361,13 @@ def rmatvec_windows_pallas(
                 chunk = c
                 break
         else:
-            raise ValueError(
-                f"instance length {length} has no aligned chunk divisor"
-            )
+            # No aligned divisor (custom build chunk not a multiple of 8).
+            # chunk=length would put a (length, w) one-hot in VMEM — fine
+            # for modest lengths, a Mosaic VMEM blowup for big ones — so
+            # large undivisible instances route to the pure-XLA scan
+            # variant instead (correct everywhere, just not MXU-shaped).
+            if length > 4096:
+                return rmatvec_windows_onehot(windows, per_row, dim)
     # f32 accumulation: the MXU path is TPU-only, where x64 is unsupported
     contrib = _contrib(windows, per_row).astype(jnp.float32)
 

@@ -299,21 +299,45 @@ def minimize_lbfgs(
 
     s = lax.while_loop(cond, body, init)
 
+    f_final, g_final = s.f, s.g
+    n_evals, n_passes = s.n_evals, s.n_passes
+    if oracle.dir_setup is not None and not has_box:
+        # (the box path re-evaluates at the projected point every
+        # iteration, so its carried values are already exact)
+        # The margin-space accept path never recomputes margins from x —
+        # the carry is z_next = z + α·z_d for the whole run, so f32
+        # rounding drift accumulates with iteration count. One exact
+        # re-evaluation at the final point bounds what downstream
+        # consumers (λ-grid model selection, variance, trackers) see;
+        # in-loop convergence still runs on carried values, whose drift
+        # (~√iters·eps relative) sits far below practical tolerances.
+        # This stays OUTSIDE the while-loop body on purpose: an in-loop
+        # periodic lax.cond refresh degrades to select under vmap and
+        # would charge every per-entity lane the full evaluation every
+        # iteration.
+        f_final, g_final, _ = eval_at(s.x)
+        n_evals = n_evals + 1
+        n_passes = n_passes + 2
+
     # Pad history tails with the final value so downstream consumers can
-    # treat the arrays as fully populated.
+    # treat the arrays as fully populated; the last populated entry is
+    # also overwritten with the exact refreshed value so
+    # loss_history[iterations] == value.
     idx = jnp.arange(t + 1)
-    loss_hist = jnp.where(idx <= s.it, s.loss_hist, s.f)
-    gnorm_hist = jnp.where(idx <= s.it, s.gnorm_hist, jnp.linalg.norm(s.g))
+    loss_hist = jnp.where(idx < s.it, s.loss_hist, f_final)
+    gnorm_hist = jnp.where(
+        idx < s.it, s.gnorm_hist, jnp.linalg.norm(g_final)
+    )
 
     return OptimizeResult(
         x=s.x,
-        value=s.f,
-        gradient=s.g,
+        value=f_final,
+        gradient=g_final,
         iterations=s.it,
         reason=s.reason,
         loss_history=loss_hist,
         grad_norm_history=gnorm_hist,
-        n_evals=s.n_evals,
+        n_evals=n_evals,
         n_hvp=jnp.zeros((), jnp.int32),
-        n_feature_passes=s.n_passes,
+        n_feature_passes=n_passes,
     )

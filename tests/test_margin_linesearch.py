@@ -72,9 +72,10 @@ def test_oracle_matches_blackbox(poisson, normalized):
     np.testing.assert_allclose(
         np.asarray(res_m.x), np.asarray(res_full.x), rtol=5e-3, atol=5e-4
     )
-    # the point of the oracle: feature passes bounded by 2/iteration + init,
-    # independent of line-search trial count
-    assert int(res_m.n_feature_passes) == 4 + 2 * int(res_m.iterations)
+    # the point of the oracle: feature passes bounded by 2/iteration + init
+    # + one final exact re-evaluation (drift bound), independent of
+    # line-search trial count
+    assert int(res_m.n_feature_passes) == 4 + 2 * int(res_m.iterations) + 2
     assert int(res_full.n_feature_passes) == 2 * int(res_full.n_evals)
 
 
