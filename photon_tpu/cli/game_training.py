@@ -140,6 +140,15 @@ def build_parser() -> argparse.ArgumentParser:
         default="VALIDATE_FULL",
         choices=[t.name for t in DataValidationType],
     )
+    p.add_argument(
+        "--checkpoint-sweeps",
+        action="store_true",
+        help="flush coordinate-descent state to <output>/checkpoints after "
+        "every sweep; a rerun of the same command resumes from the last "
+        "completed sweep with bit-identical results (requires "
+        "--override-output-directory NOT set on the rerun; output mode ALL "
+        "recommended so completed grid models are already on disk)",
+    )
     return p
 
 
@@ -189,6 +198,56 @@ def _save_summary_stats(path, summaries, index_maps) -> None:
             )
         with open(os.path.join(path, f"{shard}.json"), "w") as f:
             json.dump({"count": s.count, "features": rows}, f, indent=2)
+
+
+def _restore_skipped_grid_results(
+    results, grid_results_path, out_root, index_maps, log
+):
+    """Fill ``None`` placeholders left by a checkpoint resume for grid
+    points completed in a previous (killed) run: evaluations come from the
+    checkpoint's grid-results.jsonl sidecar, models reload from the ALL-
+    mode flush directory when present."""
+    from photon_tpu.io.model_io import load_game_model
+
+    recorded = {}
+    if grid_results_path and os.path.exists(grid_results_path):
+        with open(grid_results_path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    # a line truncated by the very crash being recovered
+                    # from must not kill the recovery path
+                    continue
+                recorded[row["grid_index"]] = row
+    out = []
+    for gi, r in enumerate(results):
+        if r is not None:
+            out.append(r)
+            continue
+        row = recorded.get(gi, {})
+        model_dir = os.path.join(out_root, MODELS_DIR, str(gi))
+        model = None
+        if os.path.isdir(model_dir):
+            model = load_game_model(model_dir, index_maps)
+        else:
+            log.warning(
+                "resume: grid %d model not on disk (run with output mode "
+                "ALL to keep completed models reloadable)",
+                gi,
+            )
+        out.append(
+            GameTrainingResult(
+                model=model,
+                evaluation=row.get("evaluation"),
+                regularization_weights=row.get(
+                    "regularization_weights", {}
+                ),
+                tracker=[],
+                wall_time_s=row.get("wall_time_s", 0.0),
+            )
+        )
+    return out
 
 
 def _select_best(
@@ -265,9 +324,31 @@ def run(argv=None) -> dict:
     id_tags = sorted(required_id_tags(coordinate_configs.values()))
     validation_id_tags = sorted(set(id_tags) | evaluator_tags)
 
-    out_root = prepare_output_dir(
-        args.root_output_directory, override=args.override_output_directory
+    ckpt_dir = (
+        os.path.join(args.root_output_directory, "checkpoints")
+        if args.checkpoint_sweeps
+        else None
     )
+    if ckpt_dir is not None and ModelOutputMode[args.output_mode] != (
+        ModelOutputMode.ALL
+    ):
+        # without the per-grid ALL-mode flush, a resume cannot reload
+        # models completed before the kill — a dead end, so refuse early
+        raise ValueError("--checkpoint-sweeps requires --output-mode ALL")
+    from photon_tpu.game.checkpoint import MANIFEST as CKPT_MANIFEST
+
+    resuming = (
+        ckpt_dir is not None
+        and os.path.exists(os.path.join(ckpt_dir, CKPT_MANIFEST))
+        and not args.override_output_directory  # override = wipe + fresh run
+    )
+    if resuming:
+        # a resume rerun reuses the existing output tree by definition
+        out_root = args.root_output_directory
+    else:
+        out_root = prepare_output_dir(
+            args.root_output_directory, override=args.override_output_directory
+        )
     emitter = EventEmitter()
     with PhotonLogger(
         os.path.join(out_root, "driver.log"), level=args.log_level
@@ -343,11 +424,14 @@ def run(argv=None) -> dict:
         # flush each grid point's model as it completes (output mode ALL):
         # a crash mid-grid keeps every finished model on disk — the
         # checkpoint-based recovery story replacing Spark task retry
-        grid_callback = None
+        grid_results_path = (
+            os.path.join(ckpt_dir, "grid-results.jsonl") if ckpt_dir else None
+        )
         flushed = set()
-        if ModelOutputMode[args.output_mode] == ModelOutputMode.ALL:
+        save_all = ModelOutputMode[args.output_mode] == ModelOutputMode.ALL
 
-            def grid_callback(gi, result):
+        def grid_callback(gi, result):
+            if save_all:
                 save_game_model(
                     os.path.join(out_root, MODELS_DIR, str(gi)),
                     result.model,
@@ -356,6 +440,19 @@ def run(argv=None) -> dict:
                     sparsity_threshold=args.model_sparsity_threshold,
                 )
                 flushed.add(gi)
+            if grid_results_path is not None:
+                with open(grid_results_path, "a") as f:
+                    f.write(
+                        json.dumps(
+                            {
+                                "grid_index": gi,
+                                "regularization_weights": result.regularization_weights,
+                                "evaluation": result.evaluation,
+                                "wall_time_s": result.wall_time_s,
+                            }
+                        )
+                        + "\n"
+                    )
 
         with Timed("train"):
             results = estimator.fit(
@@ -363,6 +460,11 @@ def run(argv=None) -> dict:
                 validation_data=validation_data,
                 initial_model=initial_model,
                 grid_callback=grid_callback,
+                checkpoint_dir=ckpt_dir,
+            )
+        if resuming and any(r is None for r in results):
+            results = _restore_skipped_grid_results(
+                results, grid_results_path, out_root, index_maps, log
             )
 
         tuning_mode = HyperparameterTuningMode[args.hyper_parameter_tuning]
@@ -423,6 +525,10 @@ def run(argv=None) -> dict:
                     for i, r in enumerate(results):
                         if i in flushed:  # already written by grid_callback
                             continue
+                        if r.model is None or os.path.isdir(
+                            os.path.join(out_root, MODELS_DIR, str(i))
+                        ):
+                            continue  # restored entry, written by prior run
                         save_game_model(
                             os.path.join(out_root, MODELS_DIR, str(i)),
                             r.model,
@@ -430,6 +536,12 @@ def run(argv=None) -> dict:
                             optimization_configurations=r.regularization_weights,
                             sparsity_threshold=args.model_sparsity_threshold,
                         )
+                if results[best].model is None:
+                    raise RuntimeError(
+                        f"best model (grid {best}) was trained by a previous "
+                        "killed run but is not on disk; rerun checkpointed "
+                        "jobs with --output-mode ALL"
+                    )
                 save_game_model(
                     os.path.join(out_root, BEST_MODEL_DIR),
                     results[best].model,

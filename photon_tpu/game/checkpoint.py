@@ -1,0 +1,206 @@
+"""Mid-descent checkpoint/resume for long coordinate-descent runs.
+
+SURVEY §5.3/§5.4: the reference delegates failure recovery to Spark task
+retry and lineage recomputation (spark/RDDLike.scala:26) and checkpoints
+only at model granularity (ModelProcessingUtils.saveGameModelToHDFS:75).
+Multi-controller JAX has no per-task retry, so the TPU-native recovery
+story is state checkpointing: after every descent sweep the per-coordinate
+optimizer states (the live device arrays), the sweep index, the grid index
+and the best-by-validation snapshot are flushed to disk. A killed run
+resumes from the last completed sweep and produces bit-identical final
+models (descent is deterministic given the states: data layout, reservoir
+sampling and down-sampling all derive from the estimator's build-time
+seed, and residual scores are recomputed from the states on resume).
+
+Layout under ``<dir>/``:
+    descent-checkpoint.json       manifest (grid/iteration/metric/keys)
+    descent-state.npz             flattened per-coordinate arrays
+    descent-best.npz              best-by-validation snapshot (optional)
+
+Writes are atomic (tmp file + os.replace) so a crash mid-write leaves the
+previous checkpoint intact.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+
+import jax.numpy as jnp
+import numpy as np
+
+MANIFEST = "descent-checkpoint.json"
+STATE_NPZ = "descent-state.npz"
+BEST_NPZ = "descent-best.npz"
+
+
+def _flatten_states(states: dict) -> dict[str, np.ndarray]:
+    """coordinate states (Array | list[Array] | tuple[Array, ...]) →
+    flat {"cid/i": ndarray} mapping with a stable order."""
+    flat = {}
+    for cid, state in states.items():
+        if isinstance(state, (list, tuple)):
+            for i, arr in enumerate(state):
+                flat[f"{cid}/{i}"] = np.asarray(arr)
+        else:
+            flat[f"{cid}/0"] = np.asarray(state)
+    return flat
+
+
+def _unflatten_states(npz, structure: dict) -> dict:
+    """Inverse of ``_flatten_states`` given the manifest's structure info:
+    cid → {"kind": "array" | "list" | "tuple", "parts": n}."""
+    states = {}
+    for cid, info in structure.items():
+        parts = [
+            jnp.asarray(npz[f"{cid}/{i}"]) for i in range(info["parts"])
+        ]
+        if info["kind"] == "array":
+            states[cid] = parts[0]
+        elif info["kind"] == "tuple":
+            states[cid] = tuple(parts)
+        else:
+            states[cid] = parts
+    return states
+
+
+def _structure_of(states: dict) -> dict:
+    out = {}
+    for cid, state in states.items():
+        if isinstance(state, tuple):
+            out[cid] = {"kind": "tuple", "parts": len(state)}
+        elif isinstance(state, list):
+            out[cid] = {"kind": "list", "parts": len(state)}
+        else:
+            out[cid] = {"kind": "array", "parts": 1}
+    return out
+
+
+def _atomic_write_npz(path: str, arrays: dict) -> None:
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+@dataclasses.dataclass
+class DescentCheckpoint:
+    """One loaded checkpoint."""
+
+    grid_index: int
+    iteration: int  # last COMPLETED sweep (0-based)
+    states: dict
+    best_states: dict | None
+    best_metric: float | None
+
+
+class DescentCheckpointer:
+    """Sweep callback writing checkpoints every ``every`` sweeps, plus the
+    loader used by ``GameEstimator.fit(checkpoint_dir=...)``."""
+
+    def __init__(self, directory: str, every: int = 1):
+        if every < 1:
+            raise ValueError("checkpoint interval must be >= 1")
+        self.directory = directory
+        self.every = every
+        os.makedirs(directory, exist_ok=True)
+
+    # -- saving --------------------------------------------------------
+
+    def on_sweep(
+        self,
+        grid_index: int,
+        iteration: int,
+        states: dict,
+        best_states: dict | None,
+        best_metric: float | None,
+        fingerprint: str | None = None,
+    ) -> None:
+        if (iteration + 1) % self.every != 0:
+            return
+        self.save(
+            grid_index, iteration, states, best_states, best_metric,
+            fingerprint=fingerprint,
+        )
+
+    def save(
+        self, grid_index, iteration, states, best_states, best_metric,
+        *, fingerprint: str | None = None,
+    ) -> None:
+        _atomic_write_npz(
+            os.path.join(self.directory, STATE_NPZ), _flatten_states(states)
+        )
+        if best_states is not None:
+            _atomic_write_npz(
+                os.path.join(self.directory, BEST_NPZ),
+                _flatten_states(best_states),
+            )
+        manifest = {
+            "grid_index": int(grid_index),
+            "iteration": int(iteration),
+            "best_metric": best_metric,
+            "has_best": best_states is not None,
+            "structure": _structure_of(states),
+            "fingerprint": fingerprint,
+        }
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(self.directory, MANIFEST))
+
+    def mark_grid_done(
+        self, grid_index: int, states: dict, fingerprint: str | None = None
+    ) -> None:
+        """A completed grid point checkpoints its FINAL states with the
+        next grid index and iteration -1, so resume warm-starts grid
+        ``grid_index + 1`` from them without re-running ``grid_index``."""
+        self.save(
+            grid_index + 1, -1, states, None, None, fingerprint=fingerprint
+        )
+
+    # -- loading -------------------------------------------------------
+
+    def load(
+        self, expect_fingerprint: str | None = None
+    ) -> DescentCheckpoint | None:
+        """Load the checkpoint; when ``expect_fingerprint`` is given, a
+        mismatch with the stored fingerprint is a hard error — resuming
+        state trained under different hyperparameters would silently
+        return wrong models."""
+        mpath = os.path.join(self.directory, MANIFEST)
+        if not os.path.exists(mpath):
+            return None
+        with open(mpath) as f:
+            manifest = json.load(f)
+        stored = manifest.get("fingerprint")
+        if (
+            expect_fingerprint is not None
+            and stored is not None
+            and stored != expect_fingerprint
+        ):
+            raise ValueError(
+                "checkpoint was written under a different training "
+                "configuration; delete the checkpoint directory "
+                f"({self.directory}) to start fresh"
+            )
+        with np.load(os.path.join(self.directory, STATE_NPZ)) as npz:
+            states = _unflatten_states(npz, manifest["structure"])
+        best_states = None
+        if manifest.get("has_best"):
+            with np.load(os.path.join(self.directory, BEST_NPZ)) as npz:
+                best_states = _unflatten_states(npz, manifest["structure"])
+        return DescentCheckpoint(
+            grid_index=manifest["grid_index"],
+            iteration=manifest["iteration"],
+            states=states,
+            best_states=best_states,
+            best_metric=manifest.get("best_metric"),
+        )

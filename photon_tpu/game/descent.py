@@ -42,12 +42,22 @@ def run_coordinate_descent(
     locked_coordinates: frozenset[str] = frozenset(),
     validation_fn: Callable[[Mapping[str, object]], float] | None = None,
     larger_is_better: bool = True,
+    start_iteration: int = 0,
+    initial_best: tuple[dict, float] | None = None,
+    sweep_callback: Callable | None = None,
 ) -> CoordinateDescentResult:
     """Run block coordinate descent.
 
     ``validation_fn(states) -> metric`` is evaluated after each full sweep;
     the best snapshot is retained (reference CoordinateDescent tracks the
     best model by validation evaluator, :240+).
+
+    Checkpoint/resume (SURVEY §5.3 — the TPU-native replacement for Spark
+    task retry): ``sweep_callback(iteration, states, best_states,
+    best_metric)`` fires after every completed sweep so callers can flush
+    recovery state; ``start_iteration``/``initial_best`` restart descent
+    from a checkpoint. Descent is deterministic given states, so a resumed
+    run is bit-identical to an uninterrupted one.
     """
     unknown = [c for c in update_sequence if c not in coordinates]
     if unknown:
@@ -68,11 +78,10 @@ def run_coordinate_descent(
         total = s if total is None else total + s
 
     tracker: list = []
-    best_states = None
-    best_metric = None
+    best_states, best_metric = initial_best or (None, None)
 
     trainable = [c for c in update_sequence if c not in locked_coordinates]
-    for it in range(num_iterations):
+    for it in range(start_iteration, num_iterations):
         for cid in trainable:
             coord = coordinates[cid]
             t0 = time.perf_counter()
@@ -104,6 +113,8 @@ def run_coordinate_descent(
             ):
                 best_metric = metric
                 best_states = dict(states)
+        if sweep_callback is not None:
+            sweep_callback(it, states, best_states, best_metric)
 
     return CoordinateDescentResult(
         states=states,

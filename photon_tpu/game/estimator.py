@@ -187,6 +187,8 @@ class GameEstimator:
         validation_data: GameData | None = None,
         initial_model: GameModel | None = None,
         grid_callback=None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
     ) -> list[GameTrainingResult]:
         """Train one GameModel per λ-grid point, warm-starting across the
         grid (reference fit :304-390 + train :746).
@@ -196,6 +198,14 @@ class GameEstimator:
         crash never loses finished models (SURVEY §5.3: the reference
         delegates recovery to Spark task retry; here checkpointing is the
         recovery story).
+
+        ``checkpoint_dir`` enables mid-descent recovery on top of that:
+        coordinate states are flushed after every ``checkpoint_every``
+        sweeps, and a rerun with the same arguments resumes from the last
+        completed sweep (skipping already-completed grid points, whose
+        models the previous run flushed through ``grid_callback``) and
+        produces bit-identical models. Entries for skipped grid points are
+        ``None`` in the returned list.
         """
         if self.ignore_threshold_for_new_models and initial_model is None:
             raise ValueError(
@@ -229,9 +239,49 @@ class GameEstimator:
             )
             validation_fn = scorer.evaluate
 
+        checkpointer = None
+        ckpt = None
+        fingerprint = None
+        if checkpoint_dir is not None:
+            from photon_tpu.game.checkpoint import DescentCheckpointer
+
+            # stale-config guard: resuming state trained under different
+            # hyperparameters must be a hard error, not silent reuse
+            fingerprint = repr(
+                (
+                    self.task,
+                    sorted(
+                        (cid, repr(cfg))
+                        for cid, cfg in self.coordinate_configs.items()
+                    ),
+                    tuple(self.update_sequence),
+                    self.descent_iterations,
+                    sorted(self.locked_coordinates),
+                    self.seed,
+                    data.num_samples,
+                )
+            )
+            checkpointer = DescentCheckpointer(
+                checkpoint_dir, every=checkpoint_every
+            )
+            ckpt = checkpointer.load(expect_fingerprint=fingerprint)
+            if ckpt is not None:
+                logger.info(
+                    "resuming from checkpoint: grid %d, sweep %d",
+                    ckpt.grid_index,
+                    ckpt.iteration,
+                )
+
         results = []
         states = init_states
         for gi in range(self._grid_length()):
+            if ckpt is not None and gi < ckpt.grid_index:
+                # completed in a previous run; its model was flushed via
+                # grid_callback then. The checkpointed states carry the
+                # warm start forward.
+                results.append(None)
+                states = ckpt.states if gi == ckpt.grid_index - 1 else states
+                continue
             t_grid = time.perf_counter()
             coords_gi = {}
             reg_weights = {}
@@ -241,6 +291,21 @@ class GameEstimator:
                 reg_weights[cid] = w
                 coords_gi[cid] = (
                     coord.with_regularization_weight(w) if gi > 0 else coord
+                )
+
+            start_iteration = 0
+            initial_best = None
+            if ckpt is not None and gi == ckpt.grid_index and ckpt.iteration >= 0:
+                states = ckpt.states
+                start_iteration = ckpt.iteration + 1
+                if ckpt.best_states is not None:
+                    initial_best = (ckpt.best_states, ckpt.best_metric)
+            sweep_callback = None
+            if checkpointer is not None:
+                sweep_callback = (
+                    lambda it, st, bs, bm, _gi=gi: checkpointer.on_sweep(
+                        _gi, it, st, bs, bm, fingerprint=fingerprint
+                    )
                 )
 
             cd = run_coordinate_descent(
@@ -255,6 +320,9 @@ class GameEstimator:
                     if self.validation_evaluator
                     else True
                 ),
+                start_iteration=start_iteration,
+                initial_best=initial_best,
+                sweep_callback=sweep_callback,
             )
             final_states = (
                 cd.best_states if cd.best_states is not None else cd.states
@@ -273,6 +341,8 @@ class GameEstimator:
             if grid_callback is not None:
                 grid_callback(gi, result)
             states = cd.states  # warm start the next grid point
+            if checkpointer is not None:
+                checkpointer.mark_grid_done(gi, states, fingerprint)
 
         return results
 

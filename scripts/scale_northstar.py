@@ -1,0 +1,268 @@
+"""Scale north star: train a ≥10⁸-coefficient sharded random-effect table.
+
+VERDICT r3 missing #1 / next-round #2: the reference claims "hundreds of
+billions of coefficients within Spark" (/root/reference/README.md:80) via
+per-entity sharding (photon-api data/RandomEffectDataSet.scala:47-56) and
+the load-balanced partitioner (RandomEffectDataSetPartitioner.scala:113-147);
+our largest trained RE table before this script was ~1.05M coefficients.
+
+This script TRAINS (not just builds) a random-effect coordinate with
+  E = 6,250,013 entities × d = 16  →  100,000,208 coefficients
+on an 8-virtual-device (1 data × 8 entity) CPU mesh — the same
+entity-sharded GSPMD path production uses on real chips — and records:
+
+  * a memory ledger: per-device bytes for the bucketed feature blocks and
+    the coefficient table, checked against a single v5e chip's 16 GiB HBM
+    (the mesh axis divides the entity axis, so per-device = total/8);
+  * sharded == unsharded numerics on a subsample: 256 entities re-trained
+    unsharded from their own rows must match the sharded table's
+    coefficients (per-entity solves are independent given the residual,
+    so equality is exact up to f32 reduction order);
+  * wall-clock for build/placement/train/score at this scale.
+
+Output: SCALE_NORTHSTAR_r04.json at the repo root (checked in).
+
+Run (about 30-40 min on a 1-core CPU host; the compute is one vmapped
+L-BFGS over 6.25M lanes):
+    python scripts/scale_northstar.py [--entities N] [--dim D]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from photon_tpu.game.config import RandomEffectCoordinateConfig  # noqa: E402
+from photon_tpu.game.coordinate import RandomEffectCoordinate  # noqa: E402
+from photon_tpu.game.data import (  # noqa: E402
+    CSRMatrix,
+    GameData,
+    build_random_effect_dataset,
+)
+from photon_tpu.optimize.common import OptimizerConfig  # noqa: E402
+from photon_tpu.optimize.problem import (  # noqa: E402
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.parallel.mesh import make_mesh  # noqa: E402
+from photon_tpu.types import TaskType  # noqa: E402
+
+V5E_HBM_BYTES = 16 << 30  # one v5e chip
+
+
+def re_config(max_iter: int) -> RandomEffectCoordinateConfig:
+    return RandomEffectCoordinateConfig(
+        random_effect_type="userId",
+        feature_shard="per_user",
+        optimization=GLMProblemConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization=RegularizationContext(
+                regularization_type=RegularizationType.L2
+            ),
+            optimizer_config=OptimizerConfig(
+                max_iterations=max_iter, ls_max_iterations=4
+            ),
+        ),
+        regularization_weights=(1.0,),
+        active_data_upper_bound=64,
+    )
+
+
+def build_data(num_entities: int, d_re: int, seed: int) -> GameData:
+    rng = np.random.default_rng(seed)
+    # every entity appears at least once; a Zipf head carries the skew the
+    # reference's greedy bin-packing partitioner exists for
+    extra = num_entities // 4
+    n = num_entities + extra
+    uid = np.concatenate(
+        [
+            np.arange(num_entities),
+            (rng.zipf(1.3, size=extra) - 1) % num_entities,
+        ]
+    )
+    x = rng.normal(size=(n, d_re)).astype(np.float32)
+    w_true = rng.normal(size=d_re)
+    z = x @ w_true + rng.normal(scale=0.5, size=n)
+    y = (z > 0).astype(np.float64)
+    return GameData.build(
+        labels=y,
+        feature_shards={"per_user": CSRMatrix.from_dense(x)},
+        id_tags={"userId": uid},
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entities", type=int, default=6_250_013)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--max-iter", type=int, default=2)
+    ap.add_argument("--subsample", type=int, default=256)
+    ap.add_argument("--out", default="SCALE_NORTHSTAR_r04.json")
+    args = ap.parse_args()
+
+    entity_shards = 8
+    report = {
+        "target": "train a >=1e8-coefficient sharded random-effect table",
+        "entities": args.entities,
+        "dim": args.dim,
+        "coefficients": args.entities * args.dim,
+        "mesh": {"data": 1, "entity": entity_shards},
+        "reference": "README.md:80, RandomEffectDataSet.scala:47-56",
+    }
+    cfg = re_config(args.max_iter)
+
+    t0 = time.perf_counter()
+    data = build_data(args.entities, args.dim, seed=0)
+    report["datagen_s"] = round(time.perf_counter() - t0, 1)
+    report["samples"] = data.num_samples
+    print(f"datagen {report['datagen_s']}s n={data.num_samples}", flush=True)
+
+    t0 = time.perf_counter()
+    ds = build_random_effect_dataset(
+        data, cfg, seed=0, entity_shards=entity_shards
+    )
+    report["build_s"] = round(time.perf_counter() - t0, 1)
+    assert ds.num_entities == args.entities
+
+    budget = ds.memory_budget()
+    waste = ds.padding_waste()
+    coef_bytes = budget["coefficient_bytes"]
+    # entity-sharded: every bucket's entity axis divides the mesh entity
+    # dimension, so per-device bytes are 1/8 of the total
+    per_device = (budget["total_bytes"] + coef_bytes) / entity_shards
+    report["memory_ledger"] = {
+        "feature_blocks_bytes": budget["total_bytes"],
+        "coefficient_count": budget["coefficient_count"],
+        "coefficient_bytes": coef_bytes,
+        "per_device_bytes": int(per_device),
+        "per_device_gib": round(per_device / (1 << 30), 3),
+        "v5e_hbm_gib": 16,
+        "fits_v5e": bool(per_device < V5E_HBM_BYTES),
+        "padding_waste": waste["total_waste"],
+        "buckets": len(ds.buckets),
+    }
+    assert budget["coefficient_count"] >= args.entities * args.dim, budget[
+        "coefficient_count"
+    ]
+    report["at_target_scale"] = budget["coefficient_count"] >= 100_000_000
+    assert per_device < V5E_HBM_BYTES, report["memory_ledger"]
+    print(
+        f"build {report['build_s']}s: {budget['coefficient_count']:,} coefs, "
+        f"{per_device / (1 << 30):.2f} GiB/device, "
+        f"waste {waste['total_waste']:.2%}",
+        flush=True,
+    )
+
+    mesh = make_mesh(num_data=1, num_entity=entity_shards)
+    t0 = time.perf_counter()
+    coord = RandomEffectCoordinate.build(
+        data, ds, cfg, jnp.float32, mesh=mesh
+    )
+    report["device_place_s"] = round(time.perf_counter() - t0, 1)
+    print(f"place {report['device_place_s']}s", flush=True)
+
+    t0 = time.perf_counter()
+    residual = jnp.zeros((data.num_samples,), jnp.float32)
+    state, _ = coord.train(residual, coord.initial_state())
+    jax.block_until_ready(state)
+    report["train_s"] = round(time.perf_counter() - t0, 1)
+    print(f"train {report['train_s']}s", flush=True)
+
+    t0 = time.perf_counter()
+    scores = coord.score(state)
+    jax.block_until_ready(scores)
+    report["score_s"] = round(time.perf_counter() - t0, 1)
+    s_np = np.asarray(scores)
+    assert np.all(np.isfinite(s_np))
+    report["score_nonzero_frac"] = float(np.mean(s_np != 0.0))
+    print(f"score {report['score_s']}s", flush=True)
+
+    # --- sharded == unsharded subsample parity ---------------------------
+    # Per-entity solves are independent given the residual, so re-training
+    # a subsample's entities unsharded from exactly their rows must land on
+    # the same coefficients. Only UNCAPPED buckets participate (reservoir
+    # sampling for capped entities draws different rows in a different
+    # build, which is sampling variance, not a numerics difference).
+    rng = np.random.default_rng(7)
+    keys_arr = np.asarray(data.id_tags["userId"])
+    ub = cfg.active_data_upper_bound
+    picked = []
+    eligible = [
+        (b, bucket)
+        for b, bucket in enumerate(ds.buckets)
+        if bucket.padded_samples < (ub or 1 << 30)
+    ]
+    for b, bucket in eligible:
+        k = max(1, args.subsample // max(1, len(eligible)))
+        ids = rng.choice(
+            len(bucket.entity_ids), size=min(k, len(bucket.entity_ids)),
+            replace=False,
+        )
+        picked.extend((b, int(i), int(bucket.entity_ids[i])) for i in ids)
+    sub_keys = {str(ds.vocab[e]) for _, _, e in picked}
+    mask = np.isin(keys_arr, sorted(sub_keys))
+    sub_rows = np.nonzero(mask)[0]
+    shard = data.feature_shards["per_user"]
+    sub_x = shard.to_dense()[sub_rows]
+    sub_data = GameData.build(
+        labels=np.asarray(data.labels)[sub_rows],
+        feature_shards={"per_user": CSRMatrix.from_dense(sub_x)},
+        id_tags={"userId": keys_arr[sub_rows]},
+    )
+    sub_ds = build_random_effect_dataset(sub_data, cfg, seed=0)
+    sub_coord = RandomEffectCoordinate.build(sub_data, sub_ds, cfg, jnp.float32)
+    sub_state, _ = sub_coord.train(
+        jnp.zeros((sub_data.num_samples,), jnp.float32),
+        sub_coord.initial_state(),
+    )
+    jax.block_until_ready(sub_state)
+    # compare coefficients entity by entity (string entity keys)
+    sub_lookup = {}
+    for bucket, coefs in zip(sub_ds.buckets, sub_state):
+        c = np.asarray(coefs)
+        for i, e in enumerate(bucket.entity_ids):
+            sub_lookup[str(sub_ds.vocab[e])] = c[i]
+    max_diff = 0.0
+    compared = 0
+    for b, i, e in picked:
+        key = str(ds.vocab[e])
+        if key not in sub_lookup:
+            continue
+        big = np.asarray(state[b])[i]
+        small = sub_lookup[key]
+        if big.shape != small.shape:
+            continue  # different projected dim bucketing; skip
+        max_diff = max(max_diff, float(np.abs(big - small).max()))
+        compared += 1
+    report["subsample_parity"] = {
+        "entities_compared": compared,
+        "max_abs_coef_diff": max_diff,
+    }
+    assert compared >= args.subsample // 2, compared
+    assert max_diff < 5e-4, max_diff
+    print(
+        f"subsample parity: {compared} entities, max|Δw| = {max_diff:.2e}",
+        flush=True,
+    )
+
+    report["ok"] = True
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"wrote {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

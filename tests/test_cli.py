@@ -762,3 +762,41 @@ def test_training_driver_grouped_validation_evaluator(avro_data, tmp_path):
     )
     [r] = res["results"]
     assert r.evaluation is not None and 0.0 <= r.evaluation <= 1.0
+
+
+def test_game_training_checkpoint_resume(avro_data, tmp_path):
+    """--checkpoint-sweeps: a rerun of the exact same completed command
+    resumes from the checkpoint, retrains nothing, reloads the flushed
+    models + recorded evaluations, and rewrites an identical summary."""
+    out = tmp_path / "training"
+    argv = [
+        "--input-data-directories", str(avro_data / "train"),
+        "--validation-data-directories", str(avro_data / "valid"),
+        "--root-output-directory", str(out),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configurations", SHARD_ARG,
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=10,"
+        "regularization=L2,reg.weights=1|10",
+        "--coordinate-update-sequence", "global",
+        "--evaluators", "AUC",
+        "--output-mode", "ALL",
+        "--checkpoint-sweeps",
+    ]
+    res1 = game_training.run(argv)
+    summary1 = json.loads((out / "training-summary.json").read_text())
+    assert (out / "checkpoints" / "descent-checkpoint.json").exists()
+    assert (out / "checkpoints" / "grid-results.jsonl").exists()
+
+    # rerun: no retraining (all grid points checkpointed as done), models
+    # restored from disk, evaluations from the sidecar
+    res2 = game_training.run(argv)
+    assert res2["best"] == res1["best"]
+    for r in res2["results"]:
+        assert r.model is not None
+        assert r.evaluation is not None
+    summary2 = json.loads((out / "training-summary.json").read_text())
+    assert summary2["best"] == summary1["best"]
+    assert [m["evaluation"] for m in summary2["models"]] == [
+        m["evaluation"] for m in summary1["models"]
+    ]
