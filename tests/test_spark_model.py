@@ -1,0 +1,47 @@
+"""Sanity pins for the analytic Spark cost model behind vs_baseline
+(spark_cost_model.py; BASELINE.md "The Spark side of vs_baseline")."""
+import spark_cost_model as scm
+
+
+def test_eval_time_positive_and_monotonic():
+    base = scm.eval_seconds(1 << 20, 24.0, 1 << 20)
+    assert base > 0
+    assert scm.eval_seconds(1 << 22, 24.0, 1 << 20) > base  # more rows
+    assert scm.eval_seconds(1 << 20, 24.0, 1 << 22) > base  # wider gradient
+
+
+def test_reduce_dominates_at_high_dim():
+    """At config-3 shape the d-vector treeAggregate is the bottleneck —
+    the first-order reality the reference's treeAggregateDepth knob
+    exists for (GameEstimator.scala:193)."""
+    c = scm.DEFAULT_CLUSTER
+    d = 1 << 20
+    t_reduce = c.executors * d * 8.0 / c.network_bw
+    t = scm.eval_seconds(1 << 20, 24.0, d)
+    assert t_reduce / t > 0.5
+
+
+def test_schedule_dominates_tiny_jobs():
+    """a1a-sized jobs are scheduling-bound on Spark, not compute-bound."""
+    t = scm.eval_seconds(1605, 14.0, 124)
+    assert abs(t - scm.DEFAULT_CLUSTER.job_overhead_s) / t < 0.05
+
+
+def test_per_executor_rate_shape():
+    r_small = scm.examples_per_sec_per_executor(1605, 14.0, 124, 10)
+    r_big = scm.examples_per_sec_per_executor(1 << 21, 24.0, 1 << 17, 40)
+    assert 0 < r_small < r_big  # amortizing overheads helps Spark
+
+
+def test_hvp_rounds_cost_like_evals():
+    a = scm.fixed_effect_run_seconds(1 << 18, 64.0, 2048, 10, 0)
+    b = scm.fixed_effect_run_seconds(1 << 18, 64.0, 2048, 10, 5)
+    assert b > a
+    assert abs(b - a - 5 * scm.eval_seconds(1 << 18, 64.0, 2048)) < 1e-9
+
+
+def test_game_sweep_includes_re_shuffle():
+    fe = (1 << 18, 24.0, 1 << 14, 8)
+    no_re = scm.game_sweep_seconds(fe, [])
+    with_re = scm.game_sweep_seconds(fe, [(1 << 18, 16.0, 3.0, 192.0)])
+    assert with_re > no_re
