@@ -831,8 +831,6 @@ def _run_game_config(
         name: build_random_effect_dataset(data, coord_configs[name], seed=seed)
         for name, *_ in coords_spec
     }
-    per_coord = _game_examples_from_tracker(result.tracker, datasets, n)
-
     waste = {}
     re_state = {}
     for name, ds in datasets.items():
@@ -903,13 +901,15 @@ def _run_game_config(
         "examples_per_sec": round(total_examples / steady_s, 1)
         if steady_s > 0
         else None,
+        # measured (steady) window only — the same window
+        # examples_per_sec and the Spark model cover
         "per_coordinate": {
             cid: {
                 "seconds": round(v["seconds"], 4),
                 "examples": v["examples"],
                 "n_evals": v["evals"],
             }
-            for cid, v in per_coord.items()
+            for cid, v in steady_examples.items()
         },
         "padding_waste": waste,
         "re_state": re_state,

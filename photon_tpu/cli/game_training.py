@@ -176,28 +176,43 @@ def _normalization_contexts(
 
 
 def _save_summary_stats(path, summaries, index_maps) -> None:
-    """Feature stats output (reference calculateAndSaveFeatureShardStats;
-    FeatureSummarizationResultAvro is JSON-mirrored here)."""
-    os.makedirs(path, exist_ok=True)
+    """Feature stats output as FeatureSummarizationResultAvro records
+    (reference ModelProcessingUtils.writeBasicStatistics:515-585: one
+    record per feature with the (name, term) split and a metrics map keyed
+    max/min/mean/normL1/normL2/numNonzeros/variance), one
+    ``<shard>/part-00000.avro`` per feature shard."""
+    from photon_tpu.data.index_map import INTERSECT
+    from photon_tpu.io.avro import write_avro_file
+    from photon_tpu.io.schemas import FEATURE_SUMMARIZATION_RESULT_AVRO
+
     for shard, s in summaries.items():
-        rows = []
         imap = index_maps[shard]
-        for j in range(len(imap)):
-            rows.append(
-                {
-                    "featureKey": imap.get_feature_name(j),
-                    "mean": float(s.mean[j]),
-                    "variance": float(s.variance[j]),
-                    "numNonzeros": int(s.num_nonzeros[j]),
-                    "max": float(s.max[j]),
-                    "min": float(s.min[j]),
-                    "normL1": float(s.norm_l1[j]),
-                    "normL2": float(s.norm_l2[j]),
-                    "meanAbs": float(s.mean_abs[j]),
+
+        def records():
+            for j in range(len(imap)):
+                key = imap.get_feature_name(j)
+                name, _, term = key.partition(INTERSECT)
+                yield {
+                    "featureName": name,
+                    "featureTerm": term,
+                    "metrics": {
+                        "max": float(s.max[j]),
+                        "min": float(s.min[j]),
+                        "mean": float(s.mean[j]),
+                        "normL1": float(s.norm_l1[j]),
+                        "normL2": float(s.norm_l2[j]),
+                        "numNonzeros": float(s.num_nonzeros[j]),
+                        "variance": float(s.variance[j]),
+                    },
                 }
-            )
-        with open(os.path.join(path, f"{shard}.json"), "w") as f:
-            json.dump({"count": s.count, "features": rows}, f, indent=2)
+
+        shard_dir = os.path.join(path, shard)
+        os.makedirs(shard_dir, exist_ok=True)
+        write_avro_file(
+            os.path.join(shard_dir, "part-00000.avro"),
+            FEATURE_SUMMARIZATION_RESULT_AVRO,
+            records(),
+        )
 
 
 def _restore_skipped_grid_results(

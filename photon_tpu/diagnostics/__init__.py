@@ -66,6 +66,35 @@ def _fmt(v: float) -> str:
     return f"{v:.6g}"
 
 
+def _roc_points(
+    scores: np.ndarray, labels: np.ndarray, max_points: int = 200
+) -> tuple[list[float], list[float]]:
+    """Exact ROC sweep downsampled to ≤``max_points`` polyline vertices.
+
+    Tied scores collapse to ONE vertex per distinct threshold — a constant
+    scorer must plot as the chance diagonal, not an order-dependent
+    staircase."""
+    s = np.asarray(scores, dtype=np.float64)
+    order = np.argsort(-s, kind="stable")
+    s_sorted = s[order]
+    pos = (np.asarray(labels, dtype=np.float64)[order] > 0.5).astype(
+        np.float64
+    )
+    tp = np.concatenate([[0.0], np.cumsum(pos)])
+    fp = np.concatenate([[0.0], np.cumsum(1.0 - pos)])
+    # vertex after each distinct-threshold group (plus the origin)
+    ends = np.concatenate(
+        [[0], np.nonzero(np.diff(s_sorted))[0] + 1, [len(s_sorted)]]
+    )
+    tp, fp = tp[ends], fp[ends]
+    p, f = max(tp[-1], 1.0), max(fp[-1], 1.0)
+    tpr, fpr = tp / p, fp / f
+    if len(tpr) > max_points:
+        idx = np.linspace(0, len(tpr) - 1, max_points).astype(int)
+        tpr, fpr = tpr[idx], fpr[idx]
+    return [float(x) for x in fpr], [float(y) for y in tpr]
+
+
 def diagnose_models(
     models: Sequence,
     data,
@@ -149,6 +178,24 @@ def diagnose_models(
         means = np.asarray(model.compute_mean(margins))
 
         if task == TaskType.LOGISTIC_REGRESSION:
+            # ROC curve (reference BinaryClassifierDiagnostic plots the
+            # curve via xchart; here ≤200 polyline points from the exact
+            # rank sweep)
+            fpr, tpr = _roc_points(means, np.asarray(data.labels)[:n])
+            sections.append(
+                Section(
+                    "ROC curve",
+                    [
+                        LineChart(
+                            "Receiver operating characteristic",
+                            "false positive rate",
+                            "true positive rate",
+                            fpr,
+                            {"model": tpr, "chance": list(fpr)},
+                        )
+                    ],
+                )
+            )
             hl = hosmer_lemeshow(
                 means, data.labels, data.weights
             )
@@ -158,10 +205,26 @@ def diagnose_models(
                 "p_value": hl.p_value,
                 "well_calibrated": hl.well_calibrated,
             }
+            occupied = [b for b in hl.bins if b.count > 0]
             sections.append(
                 Section(
                     "Hosmer–Lemeshow calibration",
                     [
+                        LineChart(
+                            "Calibration: observed vs expected positive "
+                            "rate per bin",
+                            "expected positive fraction",
+                            "observed positive fraction",
+                            [b.expected_pos / b.count for b in occupied],
+                            {
+                                "bins": [
+                                    b.observed_pos / b.count for b in occupied
+                                ],
+                                "ideal": [
+                                    b.expected_pos / b.count for b in occupied
+                                ],
+                            },
+                        ),
                         Text(
                             f"χ² = {hl.chi_square:.4g} on "
                             f"{hl.degrees_of_freedom} df, "

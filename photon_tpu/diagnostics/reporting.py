@@ -220,16 +220,38 @@ th{background:#eef}caption{font-style:italic;padding:4px}
 
 
 def render_html(doc: Document) -> str:
+    """Numbered chapters/sections with anchors and a table of contents
+    (reference html/DocumentToHTMLRenderer.scala numbers the logical tree
+    and emits navigation)."""
     parts = [
         "<!DOCTYPE html><html><head><meta charset='utf-8'>",
         f"<title>{html.escape(doc.title)}</title>",
         f"<style>{_CSS}</style></head><body>",
         f"<h1>{html.escape(doc.title)}</h1>",
     ]
-    for chapter in doc.chapters:
-        parts.append(f"<h2>{html.escape(chapter.title)}</h2>")
-        for section in chapter.sections:
-            parts.append(f"<h3>{html.escape(section.title)}</h3>")
+    toc = ["<nav><strong>Contents</strong><ul>"]
+    for ci, chapter in enumerate(doc.chapters, 1):
+        toc.append(
+            f'<li><a href="#ch{ci}">{ci}. '
+            f"{html.escape(chapter.title)}</a><ul>"
+        )
+        for si, section in enumerate(chapter.sections, 1):
+            toc.append(
+                f'<li><a href="#ch{ci}s{si}">{ci}.{si} '
+                f"{html.escape(section.title)}</a></li>"
+            )
+        toc.append("</ul></li>")
+    toc.append("</ul></nav>")
+    parts.extend(toc)
+    for ci, chapter in enumerate(doc.chapters, 1):
+        parts.append(
+            f'<h2 id="ch{ci}">{ci}. {html.escape(chapter.title)}</h2>'
+        )
+        for si, section in enumerate(chapter.sections, 1):
+            parts.append(
+                f'<h3 id="ch{ci}s{si}">{ci}.{si} '
+                f"{html.escape(section.title)}</h3>"
+            )
             parts.extend(_render_item(i) for i in section.items)
     parts.append("</body></html>")
     return "\n".join(parts)

@@ -29,6 +29,17 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent compilation cache (VERDICT r3 weak #7: the full pyramid must
+# stay locally runnable): repeated runs skip recompiling the jit programs
+# that dominate suite wall-clock. Safe to share across shards — entries are
+# keyed by HLO hash. Override location with PHOTON_TEST_CACHE_DIR; disable
+# with PHOTON_TEST_CACHE_DIR=off.
+_cache_dir = os.environ.get("PHOTON_TEST_CACHE_DIR", "/tmp/photon-jax-cache")
+if _cache_dir.lower() != "off":
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+
 # Sanitizer analogue (SURVEY §5.2): PHOTON_DEBUG_NANS=1 makes every NaN
 # produced inside a jit program raise at the producing op — the functional
 # counterpart of the JVM's memory-safety guarantees the reference leans on.

@@ -800,3 +800,35 @@ def test_game_training_checkpoint_resume(avro_data, tmp_path):
     assert [m["evaluation"] for m in summary2["models"]] == [
         m["evaluation"] for m in summary1["models"]
     ]
+
+
+def test_feature_stats_avro_output(avro_data, tmp_path):
+    """--data-summary-directory writes FeatureSummarizationResultAvro
+    records (reference ModelProcessingUtils.writeBasicStatistics:515-585),
+    readable back through the codec with the reference's metric keys."""
+    from photon_tpu.io.avro import read_avro_file
+
+    out = tmp_path / "training"
+    stats_dir = tmp_path / "stats"
+    game_training.run(
+        [
+            "--input-data-directories", str(avro_data / "train"),
+            "--root-output-directory", str(out),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,reg.weights=1",
+            "--coordinate-update-sequence", "global",
+            "--data-summary-directory", str(stats_dir),
+            "--output-mode", "NONE",
+        ]
+    )
+    recs = read_avro_file(str(stats_dir / "global" / "part-00000.avro"))
+    assert len(recs) > 0
+    r = recs[0]
+    assert set(r) == {"featureName", "featureTerm", "metrics"}
+    assert set(r["metrics"]) == {
+        "max", "min", "mean", "normL1", "normL2", "numNonzeros", "variance",
+    }
+    # variance sanity: nonnegative everywhere
+    assert all(rec["metrics"]["variance"] >= 0 for rec in recs)

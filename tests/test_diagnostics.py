@@ -325,3 +325,8 @@ def test_diagnose_models_end_to_end(tmp_path):
     assert os.path.exists(os.path.join(out, "report.json"))
     page = open(os.path.join(out, "report.html")).read()
     assert "Hosmer" in page and "Bootstrap" in page
+    # numbered TOC with anchors (reference DocumentToHTMLRenderer) + the
+    # per-model ROC and calibration plots
+    assert "<nav>" in page and 'href="#ch1s1"' in page and 'id="ch1s1"' in page
+    assert "Receiver operating characteristic" in page
+    assert "observed vs expected" in page

@@ -8,6 +8,7 @@ replaces treeAggregate (SURVEY.md §5.8).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from photon_tpu.ops.losses import LogisticLoss
 from photon_tpu.ops.objective import GLMObjective
@@ -117,6 +118,7 @@ def test_entity_axis_vmapped_solves_on_mesh():
         np.testing.assert_allclose(res.x[e], expected, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_game_estimator_mesh_matches_unsharded():
     """Full GAME training (FE + RE coordinate descent) on a (4, 2) mesh
     must reproduce single-device numerics — the estimator-level analogue of
