@@ -376,6 +376,12 @@ def _pack_shape_keys(n_pad: np.ndarray, d_pad: np.ndarray) -> np.ndarray:
     return n_pad.astype(np.int64) << 32 | d_pad.astype(np.int64)
 
 
+#: auto-consolidation stops at merges adding this many padded cells: 1M
+#: f32 cells ≈ 4 MB of extra blocks ≈ microseconds of VPU/HBM work, traded
+#: against one saved per-sweep program dispatch (tens of µs on device)
+_MERGE_CELL_BUDGET = 1_000_000
+
+
 def _consolidate_shapes(
     keys: np.ndarray, counts: np.ndarray, max_buckets: int | None
 ) -> np.ndarray | None:
@@ -388,14 +394,27 @@ def _consolidate_shapes(
     counts. Returns the merged key per input class (or None when nothing
     merges). Greedy: repeatedly merge the PAIR of shapes whose union shape
     (elementwise max) adds the fewest padded cells across both shapes'
-    entities. Deterministic, so sharded==unsharded bucketing stays stable.
-    ``PHOTON_RE_MAX_BUCKETS`` overrides for A/B measurement (0 disables).
+    entities. Two stopping rules compose:
+
+    * auto (always on): keep merging while the best merge adds fewer than
+      ``_MERGE_CELL_BUDGET`` padded cells. The unit is absolute on
+      purpose: one bucket = one dispatched program per sweep (tens of µs
+      on device), while a padded cell costs ~ns of VPU/HBM time — so a
+      sub-million-cell merge is always profitable, and a huge merge (e.g.
+      doubling a million-entity bucket's rows) is always refused,
+      independent of what fraction of the dataset it is;
+    * ``max_buckets`` hard cap (optional): keep merging regardless of cost
+      until the count is reached — for on-chip A/B of the padding-vs-
+      program-count tradeoff (``PHOTON_RE_MAX_BUCKETS`` overrides; 0
+      disables consolidation entirely).
+
+    Deterministic, so sharded==unsharded bucketing stays stable.
     """
     env = os.environ.get("PHOTON_RE_MAX_BUCKETS", "").strip()
     if env:
-        max_buckets = int(env) or None
-    if max_buckets is None or len(keys) <= max_buckets:
-        return None
+        max_buckets = int(env)
+    if max_buckets is not None and max_buckets <= 0:
+        return None  # 0 (or anything non-positive) disables consolidation
     shapes = [
         [int(k >> 32), int(k & 0xFFFFFFFF), int(c)]
         for k, c in zip(keys, counts)
@@ -403,7 +422,8 @@ def _consolidate_shapes(
     # target[i] = index of the shape entity-class i was merged into
     target = list(range(len(shapes)))
     alive = set(target)
-    while len(alive) > max_buckets:
+    merged_any = False
+    while len(alive) > 1:
         best = None
         alive_list = sorted(alive)
         for ai in range(len(alive_list)):
@@ -415,12 +435,18 @@ def _consolidate_shapes(
                 )
                 if best is None or added < best[0]:
                     best = (added, alive_list[ai], alive_list[bi], nm, dm)
-        _, ai, bi, nm, dm = best
+        added, ai, bi, nm, dm = best
+        over_cap = max_buckets is not None and len(alive) > max_buckets
+        if not over_cap and added >= _MERGE_CELL_BUDGET:
+            break
         shapes[ai] = [nm, dm, shapes[ai][2] + shapes[bi][2]]
         alive.discard(bi)
+        merged_any = True
         for i, t in enumerate(target):
             if t == bi:
                 target[i] = ai
+    if not merged_any:
+        return None
     return np.asarray(
         [
             np.int64(shapes[target[i]][0]) << 32
@@ -617,10 +643,14 @@ def build_random_effect_dataset(
     d_pad = _ceil_pow2_vec(np.maximum(d_proj[ent_list], 1), floor=8)
     combined = _pack_shape_keys(n_pad, d_pad)
     shape_keys, shape_inv = np.unique(combined, return_inverse=True)
-    merged = _consolidate_shapes(
-        shape_keys,
-        np.bincount(shape_inv, minlength=len(shape_keys)),
-        config.max_buckets,
+    merged = (
+        _consolidate_shapes(
+            shape_keys,
+            np.bincount(shape_inv, minlength=len(shape_keys)),
+            config.max_buckets,
+        )
+        if len(shape_keys) > 1
+        else None
     )
     if merged is not None:
         combined = merged[shape_inv]
