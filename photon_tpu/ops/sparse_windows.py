@@ -40,8 +40,13 @@ window instances sharded explicitly over the mesh with ``shard_map``
 (column-range partials + one psum), reusing this module's kernels
 per shard.
 
-Selection: ``PHOTON_SPARSE_RMATVEC`` = auto (default) | pallas | onehot |
-flat | segment. AUTO → pallas on TPU, onehot elsewhere.
+- **Prefix-sum variant**: within an instance the local columns are
+  non-decreasing (column sort), so per-column sums are differences of the
+  contribution cumsum at build-time-static boundaries (``bounds``) — a
+  fully dense gather-only path with no scatter and no custom kernel.
+
+Selection: ``PHOTON_SPARSE_RMATVEC`` = auto (default) | prefix | pallas |
+onehot | flat | segment. AUTO → prefix on TPU, onehot elsewhere.
 """
 from __future__ import annotations
 
@@ -64,7 +69,12 @@ class ColumnWindows(NamedTuple):
     instance (non-decreasing); ``iota``: [w] = arange(window) — carried as
     an array so the window width rides a static *shape* through jit (an int
     leaf would be traced away) and doubles as the one-hot compare operand.
-    Padding slots: row 0, local col w−1, value 0.
+    ``bounds``: [W_inst, w+1] exclusive prefix counts per local column
+    (bounds[i, c] = #slots in instance i with lcol < c) — static segment
+    boundaries for the prefix-sum rmatvec; ``None`` on layouts built before
+    the field existed. Padding slots: row 0, local col w−1, value 0.
+    W_inst is padded to a multiple of 8 at build time (inert instances) so
+    the Pallas block shape (8, L) satisfies the TPU sublane rule.
     """
 
     rows: Array
@@ -72,6 +82,7 @@ class ColumnWindows(NamedTuple):
     vals: Array
     inst2win: Array
     iota: Array
+    bounds: Array | None = None
 
     @property
     def window(self) -> int:
@@ -207,8 +218,13 @@ def build_column_windows(
     length = cap
     n_inst = np.maximum(1, -(-counts // cap))
     w_inst = int(n_inst.sum())
+    # Round the instance count to a multiple of 8 with inert instances
+    # (vals 0 / lcol w−1 / last window id) so the Pallas kernel's (8, L)
+    # block shape meets the TPU sublane-divisibility rule for any layout.
+    w_inst_pad = (-w_inst) % 8
     inst_base = np.concatenate([[0], np.cumsum(n_inst)])[:-1]
     win_start = np.concatenate([[0], np.cumsum(counts)])
+    w_inst += w_inst_pad
 
     rows = np.zeros(w_inst * length, dtype=np.int32)
     lcols = np.full(w_inst * length, window - 1, dtype=np.int32)
@@ -237,17 +253,37 @@ def build_column_windows(
         lcols[dest] = s_col % window
         vals[dest] = s_val
 
-    inst2win = np.repeat(
-        np.arange(num_windows, dtype=np.int32), n_inst
-    )
+    inst2win = np.concatenate([
+        np.repeat(np.arange(num_windows, dtype=np.int32), n_inst),
+        np.full(w_inst_pad, num_windows - 1, dtype=np.int32),
+    ])
+    lcols2 = lcols.reshape(w_inst, length)
     wrap = (lambda x: x) if host else jnp.asarray
     return ColumnWindows(
         rows=wrap(rows.reshape(w_inst, length)),
-        lcols=wrap(lcols.reshape(w_inst, length)),
+        lcols=wrap(lcols2),
         vals=wrap(vals.reshape(w_inst, length)),
         inst2win=wrap(inst2win),
         iota=wrap(np.arange(window, dtype=np.int32)),
+        bounds=wrap(_instance_bounds(lcols2, window)),
     )
+
+
+def _instance_bounds(lcols2: np.ndarray, window: int) -> np.ndarray:
+    """[W_inst, w+1] exclusive prefix counts per local column, chunked so
+    the combined-index temporary stays ~128 MB at config-3 scale."""
+    w_inst, length = lcols2.shape
+    bounds = np.zeros((w_inst, window + 1), dtype=np.int32)
+    step = max(1, (1 << 24) // max(length, 1))
+    for i0 in range(0, w_inst, step):
+        blk = lcols2[i0 : i0 + step].astype(np.int64)
+        k_blk = blk.shape[0]
+        comb = blk + np.arange(k_blk, dtype=np.int64)[:, None] * window
+        c2 = np.bincount(
+            comb.ravel(), minlength=k_blk * window
+        ).reshape(k_blk, window)
+        bounds[i0 : i0 + k_blk, 1:] = np.cumsum(c2, axis=1)
+    return bounds
 
 
 # ---------------------------------------------------------------------------
@@ -311,27 +347,65 @@ def rmatvec_windows_onehot(
     return _combine(out_inst, windows, dim)
 
 
+def rmatvec_windows_prefix(
+    windows: ColumnWindows, per_row: Array, dim: int
+) -> Array:
+    """Prefix-sum rmatvec: within an instance lcols are NON-DECREASING (the
+    build sorts by column), so the per-column sums are differences of the
+    contribution prefix sum at build-time-static boundaries — a cumsum plus
+    a [W_inst, w+1] gather. Fully dense, no scatter, no custom kernel: the
+    lowering-proof TPU path (measured on-chip r4: the sorted segment_sum
+    runs ~90M updates/s while this is plain bandwidth)."""
+    if windows.bounds is None:
+        return rmatvec_windows_onehot(windows, per_row, dim)
+    contrib = _contrib(windows, per_row)
+    # Mean-centering bounds the f32 cumsum drift: a segment sum becomes the
+    # difference of two prefixes, whose rounding error scales with |prefix|.
+    # For biased contributions (the variance path's d2 > 0) the raw prefix
+    # grows linearly in L; centered, it grows ~√L. The exact correction
+    # μ·count uses the static per-column counts (bounds differences).
+    mu = jnp.mean(contrib, axis=1, keepdims=True)
+    s = jnp.cumsum(contrib - mu, axis=1)
+    s = jnp.concatenate(
+        [jnp.zeros((s.shape[0], 1), s.dtype), s], axis=1
+    )
+    g = jnp.take_along_axis(s, windows.bounds, axis=1)
+    counts = (windows.bounds[:, 1:] - windows.bounds[:, :-1]).astype(
+        contrib.dtype
+    )
+    return _combine(g[:, 1:] - g[:, :-1] + mu * counts, windows, dim)
+
+
+#: instances per Pallas grid step — the TPU sublane rule requires the
+#: second-to-last block dim be a multiple of 8 (block (1, L) fails to lower)
+_PALLAS_BLK = 8
+
+
 def _pallas_kernel_factory(length: int, w: int, chunk: int):
     from jax.experimental import pallas as pl
 
     steps = max(1, length // chunk)
 
     def kernel(contrib_ref, lcols_ref, out_ref):
-        def body(j, acc):
-            cb = contrib_ref[0, pl.ds(j * chunk, chunk)].astype(jnp.float32)
-            lc = lcols_ref[0, pl.ds(j * chunk, chunk)]
-            onehot = (
-                lc[:, None]
-                == jax.lax.broadcasted_iota(jnp.int32, (chunk, w), 1)
-            ).astype(jnp.float32)
-            return acc + jnp.dot(
-                cb[None, :], onehot, preferred_element_type=jnp.float32
-            )
+        for i in range(_PALLAS_BLK):
 
-        acc = jax.lax.fori_loop(
-            0, steps, body, jnp.zeros((1, w), jnp.float32)
-        )
-        out_ref[0, :] = acc[0]
+            def body(j, acc):
+                cb = contrib_ref[i, pl.ds(j * chunk, chunk)].astype(
+                    jnp.float32
+                )
+                lc = lcols_ref[i, pl.ds(j * chunk, chunk)]
+                onehot = (
+                    lc[:, None]
+                    == jax.lax.broadcasted_iota(jnp.int32, (chunk, w), 1)
+                ).astype(jnp.float32)
+                return acc + jnp.dot(
+                    cb[None, :], onehot, preferred_element_type=jnp.float32
+                )
+
+            acc = jax.lax.fori_loop(
+                0, steps, body, jnp.zeros((1, w), jnp.float32)
+            )
+            out_ref[i, :] = acc[0]
 
     return kernel
 
@@ -351,6 +425,19 @@ def rmatvec_windows_pallas(
 
     w_inst, length = windows.rows.shape
     w = windows.window
+    # The (blk=8, L) block residency is 8× the old (1, L) blocks: two
+    # [8, L] 4-byte operands must fit VMEM alongside the [chunk, w] one-hot.
+    # Past ~2^17 slots/instance (≈8 MB of operands) a real-TPU launch would
+    # die in Mosaic with a VMEM error; fail loudly instead of silently
+    # measuring a different implementation (interpret mode has no VMEM
+    # limit and proceeds).
+    if length * _PALLAS_BLK > (1 << 20) and not interpret:
+        raise ValueError(
+            f"pallas rmatvec: instance length {length} × {_PALLAS_BLK} "
+            "sublanes exceeds the VMEM block budget; lower "
+            "PHOTON_SPARSE_WINDOW_CAP or select "
+            "PHOTON_SPARSE_RMATVEC=prefix"
+        )
     # chunk must DIVIDE the instance length or the fori_loop drops the tail
     # (build rounds length to a multiple of its chunk arg, which need not be
     # this kernel's 1024 default) — pick the largest aligned divisor.
@@ -370,25 +457,31 @@ def rmatvec_windows_pallas(
                 return rmatvec_windows_onehot(windows, per_row, dim)
     # f32 accumulation: the MXU path is TPU-only, where x64 is unsupported
     contrib = _contrib(windows, per_row).astype(jnp.float32)
+    lcols = windows.lcols
+    blk = _PALLAS_BLK
+    pad = (-w_inst) % blk
+    if pad:  # layouts from before the build-time 8-padding
+        contrib = jnp.pad(contrib, ((0, pad), (0, 0)))
+        lcols = jnp.pad(lcols, ((0, pad), (0, 0)), constant_values=w - 1)
 
     out_inst = pl.pallas_call(
         _pallas_kernel_factory(length, w, chunk),
-        out_shape=jax.ShapeDtypeStruct((w_inst, w), jnp.float32),
-        grid=(w_inst,),
+        out_shape=jax.ShapeDtypeStruct((w_inst + pad, w), jnp.float32),
+        grid=((w_inst + pad) // blk,),
         in_specs=[
             pl.BlockSpec(
-                (1, length), lambda i: (i, 0), memory_space=pltpu.VMEM
+                (blk, length), lambda i: (i, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (1, length), lambda i: (i, 0), memory_space=pltpu.VMEM
+                (blk, length), lambda i: (i, 0), memory_space=pltpu.VMEM
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, w), lambda i: (i, 0), memory_space=pltpu.VMEM
+            (blk, w), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         interpret=interpret,
-    )(contrib, windows.lcols)
-    return _combine(out_inst, windows, dim)
+    )(contrib, lcols)
+    return _combine(out_inst[:w_inst], windows, dim)
 
 
 def _env_int(name: str, default: int, *, lo: int, hi: int) -> int:
@@ -452,7 +545,15 @@ def windowed_rmatvec(
     """Implementation dispatch (trace-time; see module docstring)."""
     impl = os.environ.get(_ENV, "auto").strip().lower()
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "onehot"
+        if jax.default_backend() == "tpu":
+            # r4 on-chip measurement (PERF.md): prefix-sum beats the
+            # one-hot kernels and every segment_sum lowering at config-3
+            # scale; layouts without bounds fall back inside prefix.
+            impl = "prefix"
+        else:
+            impl = "onehot"
+    if impl == "prefix":
+        return rmatvec_windows_prefix(windows, per_row, dim)
     if impl == "pallas":
         return rmatvec_windows_pallas(windows, per_row, dim)
     if impl == "onehot":

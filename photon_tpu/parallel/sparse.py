@@ -55,12 +55,21 @@ def pad_windows_for_mesh(
         widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
         return np.pad(np.asarray(x), widths, constant_values=fill)
 
+    bounds = windows.bounds
+    if bounds is not None:
+        # an all-padding instance has every slot at lcol w−1: exclusive
+        # prefix counts are 0 for c ≤ w−1 and `length` at c = w
+        pad_rows = np.zeros((pad, w + 1), dtype=np.int32)
+        pad_rows[:, -1] = length
+        bounds = np.concatenate([np.asarray(bounds), pad_rows])
+
     return ColumnWindows(
         rows=pad_leaf(windows.rows, 0),
         lcols=pad_leaf(windows.lcols, w - 1),
         vals=pad_leaf(windows.vals, 0),
         inst2win=pad_leaf(windows.inst2win, num_windows - 1),
         iota=windows.iota,
+        bounds=bounds,
     )
 
 
@@ -83,6 +92,11 @@ def shard_windows(
         vals=put(windows.vals, inst_mat),
         inst2win=put(windows.inst2win, inst_sharded),
         iota=put(windows.iota, NamedSharding(mesh, P())),
+        bounds=(
+            None
+            if windows.bounds is None
+            else put(windows.bounds, inst_mat)
+        ),
     )
 
 
@@ -107,6 +121,9 @@ def sharded_windowed_rmatvec(
                 vals=P(axes, None),
                 inst2win=P(axes),
                 iota=P(),
+                bounds=(
+                    None if windows.bounds is None else P(axes, None)
+                ),
             ),
             P(),  # replicated residual vector
         ),

@@ -38,10 +38,14 @@ if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
 
 def timed(fn, args_list):
     """Warm on args_list[0], then time each remaining arg-tuple (distinct
-    inputs defeat relay-side result memoization); returns median seconds."""
+    inputs defeat relay-side result memoization); returns median seconds.
+    Prints the warm (compile+first-run) wall so a pathological lowering is
+    distinguishable from slow steady state."""
     import jax
 
+    t0 = time.perf_counter()
     jax.block_until_ready(fn(*args_list[0]))
+    print(f"    [warm/compile {time.perf_counter() - t0:.1f}s]", flush=True)
     outs = []
     for args in args_list[1:]:
         t0 = time.perf_counter()
@@ -57,7 +61,7 @@ def main():
     ap.add_argument("--k", type=int, default=56)
     ap.add_argument("--window", type=int, default=512)
     ap.add_argument("--only", default=None,
-                    help="single case: m1,r1,r2,r3,p1,s1,s2,s3")
+                    help="single case: m1,r1,r2,r3,p1,p2,s1,s2,s3")
     args = ap.parse_args()
 
     def want(name):
@@ -86,8 +90,12 @@ def main():
                 for _ in range(m)]
 
     if want("m1") or want("r1"):
+        t0 = time.perf_counter()
         idx_d = jax.device_put(jnp.asarray(idx))
         val_d = jax.device_put(jnp.asarray(val))
+        jax.block_until_ready((idx_d, val_d))
+        print(f"  [upload {nnz * 8 / 1e6:.0f} MB in "
+              f"{time.perf_counter() - t0:.1f}s]", flush=True)
 
     if want("m1"):
         @jax.jit
@@ -125,7 +133,7 @@ def main():
 
         report("r2 sorted segment_sum", timed(r2, mk_vs(4, n)), nnz * 12)
 
-    if want("r3") or want("p1"):
+    if want("r3") or want("p1") or want("p2"):
         import sys
 
         sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -134,6 +142,7 @@ def main():
             build_column_windows,
             rmatvec_windows_onehot,
             rmatvec_windows_pallas,
+            rmatvec_windows_prefix,
         )
 
         t0 = time.perf_counter()
@@ -162,6 +171,14 @@ def main():
 
                 report("p1 windowed one-hot Pallas", timed(p1, mk_vs(4, n)),
                        nnz * 12)
+
+        if want("p2"):
+            @jax.jit
+            def p2(r):
+                return rmatvec_windows_prefix(windows, r, d)
+
+            report("p2 windowed prefix-sum", timed(p2, mk_vs(4, n)),
+                   nnz * 12)
 
     m = n
     if want("s1") or want("s3"):

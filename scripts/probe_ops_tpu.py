@@ -1,0 +1,141 @@
+"""Dispatch-amortized op probe: true per-op device time via an in-program
+lax.scan loop (one dispatch for R reps), with a dense-matvec control.
+
+The single-dispatch micro numbers sit on a ~72 ms relay round-trip floor,
+which buries any op under ~100 ms — scanning R reps inside one program
+amortizes that floor to ~72/R ms per op. Each step folds its result back
+into the carry, so steps chain (no CSE) and every result stays live.
+
+Usage: python scripts/probe_ops_tpu.py [--reps 8] [--n 18] [--case all]
+Cases: dense | m1 | p2 | p1 | all
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=8)
+    ap.add_argument("--n", type=int, default=18)
+    ap.add_argument("--d", type=int, default=20)
+    ap.add_argument("--k", type=int, default=56)
+    ap.add_argument("--window", type=int, default=512)
+    ap.add_argument("--case", default="all")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    reps = args.reps
+    n, d, k = 1 << args.n, 1 << args.d, args.k
+    rng = np.random.default_rng(0)
+
+    def scan_timed(step, x0, nbytes, label):
+        """step: x -> x (keeps data live); one jit program runs `reps`
+        steps. Reports wall / reps as per-op time — the ~72 ms dispatch
+        floor is amortized across reps, not subtracted."""
+
+        @jax.jit
+        def prog(x):
+            def body(c, _):
+                return step(c), 0.0
+
+            out, _ = jax.lax.scan(body, x, None, length=reps)
+            return out
+
+        t0 = time.perf_counter()
+        jax.block_until_ready(prog(x0))
+        warm = time.perf_counter() - t0
+        walls = []
+        for i in range(3):
+            xi = x0 + jnp.float32(i + 1) * jnp.float32(1e-6)
+            t0 = time.perf_counter()
+            jax.block_until_ready(prog(xi))
+            walls.append(time.perf_counter() - t0)
+        wall = float(np.median(walls))
+        per_op = wall / reps
+        print(
+            f"{label:26s} warm={warm:6.1f}s wall={wall * 1e3:9.2f} ms "
+            f"per_op={per_op * 1e3:8.2f} ms  {nbytes / per_op / 1e9:8.1f} GB/s",
+            flush=True,
+        )
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} {dev.platform} reps={reps}", flush=True)
+
+    if args.case in ("dense", "all"):
+        nd, dd = 1 << 17, 4096
+        a = jax.device_put(
+            jnp.asarray(
+                rng.standard_normal((nd, dd)).astype(np.float32)
+            )
+        )
+        v0 = jnp.asarray(rng.standard_normal(dd).astype(np.float32))
+
+        def dense_step(v):
+            y = a @ v
+            return y[:dd] * jnp.float32(1e-3) + v
+
+        scan_timed(dense_step, v0, nd * dd * 4, "dense matvec 2^17x4096")
+
+    if args.case in ("m1", "all"):
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = (rng.standard_normal((n, k)) / np.sqrt(k)).astype(np.float32)
+        idx_d = jax.device_put(jnp.asarray(idx))
+        val_d = jax.device_put(jnp.asarray(val))
+        v0 = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+
+        def m1_step(v):
+            z = jnp.sum(v[idx_d] * val_d, axis=-1)
+            return v.at[:n].add(z * jnp.float32(1e-6))
+
+        scan_timed(m1_step, v0, n * k * 8, f"m1 gather matvec 2^{args.n}")
+
+    if args.case in ("p2", "p1", "all"):
+        from photon_tpu.ops.sparse_windows import (
+            build_column_windows,
+            rmatvec_windows_pallas,
+            rmatvec_windows_prefix,
+        )
+
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = (rng.standard_normal((n, k)) / np.sqrt(k)).astype(np.float32)
+        t0 = time.perf_counter()
+        windows = build_column_windows(idx, val, d, window=args.window)
+        wi, ln = windows.rows.shape
+        print(
+            f"windows: {wi}x{ln} w={args.window} build "
+            f"{time.perf_counter() - t0:.1f}s",
+            flush=True,
+        )
+        r0 = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+        if args.case in ("p2", "all"):
+
+            def p2_step(r):
+                g = rmatvec_windows_prefix(windows, r, d)
+                return r.at[:1].add(g[0] * jnp.float32(1e-9))
+
+            scan_timed(p2_step, r0, n * k * 12, f"p2 prefix 2^{args.n}")
+
+        if args.case in ("p1", "all") and dev.platform == "tpu":
+
+            def p1_step(r):
+                g = rmatvec_windows_pallas(windows, r, d)
+                return r.at[:1].add(g[0] * jnp.float32(1e-9))
+
+            scan_timed(p1_step, r0, n * k * 12, f"p1 pallas 2^{args.n}")
+
+
+if __name__ == "__main__":
+    main()

@@ -159,9 +159,15 @@ def test_bucket_consolidation_caps_bucket_count(monkeypatch):
     assert few.padding_waste()["total_waste"] < 0.9
 
     # numerics: trained scores identical across bucketings (per-entity
-    # solves see identical rows; only block shapes changed)
+    # solves see identical rows; only block shapes changed). `auto` is the
+    # production default — it must be in the identity check, not just the
+    # bucket-count assert.
     results = []
-    for ds, cfg in ((raw, base), (few, _dc.replace(base, max_buckets=6))):
+    for ds, cfg in (
+        (raw, base),
+        (auto, base),
+        (few, _dc.replace(base, max_buckets=6)),
+    ):
         coord = RandomEffectCoordinate.build(data, ds, cfg, jnp.float32)
         state, _ = coord.train(
             jnp.zeros((data.num_samples,), jnp.float32),
@@ -169,3 +175,4 @@ def test_bucket_consolidation_caps_bucket_count(monkeypatch):
         )
         results.append(np.asarray(coord.score(state)))
     np.testing.assert_allclose(results[0], results[1], rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(results[0], results[2], rtol=2e-4, atol=2e-5)

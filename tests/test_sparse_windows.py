@@ -20,6 +20,7 @@ from photon_tpu.ops.sparse_windows import (
     rmatvec_windows_flat,
     rmatvec_windows_onehot,
     rmatvec_windows_pallas,
+    rmatvec_windows_prefix,
 )
 
 
@@ -59,9 +60,81 @@ def test_all_impls_match_reference(hot_column, d):
     got_pallas = np.asarray(
         rmatvec_windows_pallas(windows, r_j, d, interpret=True)
     )
+    got_prefix = np.asarray(rmatvec_windows_prefix(windows, r_j, d))
     np.testing.assert_allclose(got_flat, expect, rtol=2e-4, atol=1e-4)
     np.testing.assert_allclose(got_onehot, expect, rtol=2e-4, atol=1e-4)
     np.testing.assert_allclose(got_pallas, expect, rtol=2e-4, atol=1e-4)
+    np.testing.assert_allclose(got_prefix, expect, rtol=2e-4, atol=1e-4)
+
+
+def test_build_pads_instances_to_multiple_of_8():
+    """The Pallas (8, L) block shape requires W_inst % 8 == 0; inert
+    padding instances must not change the algebra."""
+    rng = np.random.default_rng(3)
+    idx, val = _random_ell(rng, 100, 3, 40)
+    windows = build_column_windows(idx, val, 40, window=16, instance_cap=64)
+    w_inst = windows.rows.shape[0]
+    assert w_inst % 8 == 0
+    assert np.all(np.diff(np.asarray(windows.inst2win)) >= 0)
+
+
+def test_bounds_static_invariants():
+    """bounds[i] is a monotone exclusive prefix ending at the instance
+    length, consistent with a direct per-column count of lcols."""
+    rng = np.random.default_rng(4)
+    idx, val = _random_ell(rng, 300, 4, 96, hot_column=True)
+    windows = build_column_windows(idx, val, 96, window=32, instance_cap=64)
+    bounds = np.asarray(windows.bounds)
+    lcols = np.asarray(windows.lcols)
+    w_inst, length = lcols.shape
+    assert bounds.shape == (w_inst, windows.window + 1)
+    assert np.all(bounds[:, 0] == 0)
+    assert np.all(bounds[:, -1] == length)
+    assert np.all(np.diff(bounds, axis=1) >= 0)
+    for i in range(w_inst):
+        counts = np.bincount(lcols[i], minlength=windows.window)
+        np.testing.assert_array_equal(
+            np.cumsum(counts), bounds[i, 1:]
+        )
+
+
+def test_prefix_drift_bounded_on_biased_contributions():
+    """Variance-path shape: all-positive weights make the raw prefix grow
+    linearly in L, the worst case for diff-of-cumsum rounding; the
+    mean-centered prefix must stay close to an f64 reference even for
+    low-count columns deep inside a 4096-slot instance."""
+    rng = np.random.default_rng(6)
+    n, k, d = 5000, 8, 256
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.uniform(0.5, 1.5, size=(n, k)).astype(np.float32)
+    idx[:, 0] = 0  # hot column → one 4096-deep spill chain
+    r = rng.uniform(0.1, 2.0, size=n).astype(np.float32)  # d2-like, > 0
+    windows = build_column_windows(
+        idx, val, d, window=64, instance_cap=4096
+    )
+    expect = np.zeros(d, dtype=np.float64)
+    np.add.at(
+        expect,
+        idx.reshape(-1),
+        (val.astype(np.float64) * r.astype(np.float64)[:, None]).reshape(-1),
+    )
+    got = np.asarray(rmatvec_windows_prefix(windows, jnp.asarray(r), d))
+    np.testing.assert_allclose(got, expect, rtol=5e-5, atol=1e-3)
+
+
+def test_prefix_falls_back_without_bounds():
+    """Layouts predating the bounds field route prefix → onehot."""
+    rng = np.random.default_rng(5)
+    idx, val = _random_ell(rng, 64, 3, 32)
+    windows = build_column_windows(idx, val, 32, window=16)
+    legacy = windows._replace(bounds=None)
+    r = jnp.asarray(rng.standard_normal(64).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(rmatvec_windows_prefix(legacy, r, 32)),
+        _reference_rmatvec(idx, val, np.asarray(r), 32),
+        rtol=2e-4,
+        atol=1e-4,
+    )
 
 
 def test_pallas_chunk_divides_nondefault_length():
