@@ -170,7 +170,7 @@ def _pick(scale, smoke, cpu, tpu):
 CONFIG_PLAN = [
     ("a1a_logistic_lbfgs", 600, 3),
     ("linear_tron", 900, 3),
-    ("sparse_poisson_owlqn", 1500, 2),
+    ("sparse_poisson_owlqn", 2400, 2),
     # the GAME configs compile tens of programs (per-bucket RE solves);
     # remote compiles through the relay are slow, so their budgets cover a
     # cold cache — retries resume from the persistent compile cache
@@ -281,11 +281,17 @@ def _timed_run(fn, key):
 
     BENCH_PROFILE=<dir> wraps the timed run in a jax.profiler trace
     (VERDICT r2 weak #3: perf claims need profile evidence, not just wall
-    clocks)."""
+    clocks).
+
+    The key is folded with fresh wall-clock entropy first: the relay's
+    memoization PERSISTS ACROSS SESSIONS, so a fixed seed replays a cache
+    hit from a previous round's identical program — r4 observed a 0.1 ms
+    "wall" for a whole L-BFGS solve, under the 72 ms dispatch floor."""
     import contextlib
 
     import jax
 
+    key = jax.random.fold_in(key, time.time_ns() & 0x7FFFFFFF)
     k_warm, k_timed = jax.random.split(key)
     jax.block_until_ready(fn(k_warm))
     prof_dir = os.environ.get("BENCH_PROFILE", "").strip()
@@ -573,7 +579,13 @@ def config_sparse_poisson(peak_flops, scale):
         )
         cal_run = make_run(OptimizerConfig(max_iterations=2, tolerance=0.0))
         jax.block_until_ready(cal_run(cal_batch, jnp.zeros((d,), dtype)))
-        w0c = 1e-6 * jax.random.normal(jax.random.PRNGKey(31), (d,), dtype)
+        # entropy-fold: the relay memoizes identical (executable, inputs)
+        # ACROSS SESSIONS — a fixed seed replays last round's cached result
+        # and the gate projects from a fantasy 0.0 s calibration
+        cal_key = jax.random.fold_in(
+            jax.random.PRNGKey(31), time.time_ns() & 0x7FFFFFFF
+        )
+        w0c = 1e-6 * jax.random.normal(cal_key, (d,), dtype)
         t0 = time.perf_counter()
         cal_res = cal_run(cal_batch, w0c)
         jax.block_until_ready(cal_res)
@@ -588,6 +600,7 @@ def config_sparse_poisson(peak_flops, scale):
         )
         cal_gate = {
             "calibrated": True,
+            "cal_n": cal_n,
             "cal_wall_s": round(cal_wall, 3),
             "cal_evals": cal_evals,
             "projected_full_s": round(projected, 1),
@@ -613,15 +626,49 @@ def config_sparse_poisson(peak_flops, scale):
                 "column_windows": win_stats,
             }
 
-    run = make_run(cfg)
+    # Full-scale solve. On TPU the whole solve can be many device-minutes;
+    # one monolithic while_loop program is unkillable and can exceed the
+    # transport's per-program execution limit (observed as `UNAVAILABLE:
+    # TPU device error` mid-solve). SegmentedOWLQN re-dispatches the same
+    # solve in bounded-iteration programs sized from the calibration so
+    # each dispatch stays ~45 s.
+    segment_iters = None
+    if jax.default_backend() == "tpu" and cal_gate.get("calibrated"):
+        per_iter_full = (
+            (cal_gate["cal_wall_s"] / 2.0) * (n / float(cal_gate["cal_n"]))
+        )
+        segment_iters = max(1, min(50, int(45.0 / max(per_iter_full, 0.09))))
+    if segment_iters is not None:
+        from photon_tpu.optimize.owlqn import SegmentedOWLQN
+
+        # batch flows through as a jit ARGUMENT (oracle built at trace
+        # time) — a closed-over batch would bake ~0.5 GB of dense
+        # constants into the remotely-compiled segment program
+        solver = SegmentedOWLQN(
+            None,
+            l1,
+            cfg,
+            oracle_factory=obj.smooth_margin_oracle,
+            segment_iters=segment_iters,
+        )
+        run = lambda b, w0: solver(w0, b)  # noqa: E731
+        _log(f"[bench] config3 segmented dispatch: {segment_iters} it/seg")
+    else:
+        run = make_run(cfg)
     # warm on zeros, time from a different (≈identical-work) start point —
-    # distinct inputs defeat the relay's re-execution memoization
+    # distinct inputs (entropy-folded key) defeat the relay's cross-session
+    # re-execution memoization
     jax.block_until_ready(run(batch, jnp.zeros((d,), dtype)))
-    w0 = 1e-6 * jax.random.normal(jax.random.PRNGKey(30), (d,), dtype)
+    w0_key = jax.random.fold_in(
+        jax.random.PRNGKey(30), time.time_ns() & 0x7FFFFFFF
+    )
+    w0 = 1e-6 * jax.random.normal(w0_key, (d,), dtype)
     t0 = time.perf_counter()
     res = run(batch, w0)
     jax.block_until_ready(res)
     wall = time.perf_counter() - t0
+    if segment_iters is not None:
+        _log(f"[bench] config3 segments run: {solver.last_num_segments}")
     evals = int(res.n_evals)
     # value-only trials: one (idx, val) stream pass per trial + one
     # backward per iteration — exact from the pass counter

@@ -54,47 +54,47 @@ class _OWLQNState(NamedTuple):
     gnorm_hist: Array
     n_evals: Array
     n_passes: Array
+    # data-dependent tolerances ride the STATE (not trace constants) so a
+    # compiled segment program (SegmentedOWLQN) is reusable across solves
+    loss_abs_tol: Array
+    grad_abs_tol: Array
     carry: object  # margins of the smooth part at x (oracle mode), else ()
 
 
-def minimize_owlqn(
+def _owlqn_machinery(
     value_and_grad: Callable[[Array], tuple[Array, Array]] | None,
-    x0: Array,
     l1_weight: float,
-    config: OptimizerConfig = OptimizerConfig(),
+    config: OptimizerConfig,
     *,
-    oracle: SmoothMarginOracle | None = None,
-) -> OptimizeResult:
-    """Minimize f(x) + l1_weight·‖x‖₁ where ``value_and_grad`` evaluates the
-    smooth part f. Returns the reference-shaped ``OptimizeResult`` (the
-    ``gradient`` field holds the pseudo-gradient at the solution).
+    oracle: SmoothMarginOracle | None,
+    dtype,
+):
+    """Shared OWL-QN program pieces: ``(make_init, cond, body, finalize)``.
 
-    With a ``SmoothMarginOracle`` each backtracking trial computes the
-    VALUE only (one feature pass — Armijo never needs the gradient) and
-    the accepted point's gradient comes from its carried margins with one
-    backward pass: trials+1 passes per iteration vs 2·trials black-box.
+    ``minimize_owlqn`` runs them as one ``lax.while_loop`` program;
+    ``SegmentedOWLQN`` re-dispatches ``body`` in bounded-iteration
+    segments from the host. Both drivers execute the identical algebra;
+    results agree up to f32 reassociation across the different XLA
+    programs (iteration counts can differ by ±1 near tolerance).
     """
-    dtype = x0.dtype
     if oracle is not None and value_and_grad is not None:
         raise ValueError("pass value_and_grad=None when oracle is given")
     if oracle is None:
         if value_and_grad is None:
             raise ValueError("need value_and_grad or oracle")
+        _vg = value_and_grad
 
         def _full(x):
-            f, g = value_and_grad(x)
+            f, g = _vg(x)
             return f, g, ()
 
         oracle = SmoothMarginOracle(
             full=_full, value_margins=None, grad_from_margins=None
         )
-    d = x0.shape[-1]
     m = config.num_corrections
     t = config.max_iterations
     l1 = jnp.asarray(l1_weight, dtype)
     has_box = config.lower_bounds is not None or config.upper_bounds is not None
-    if has_box:
-        x0 = project_to_box(x0, config.lower_bounds, config.upper_bounds)
 
     def eval_smooth(x):
         f, g, carry = oracle.full(x)
@@ -103,34 +103,36 @@ def minimize_owlqn(
     def full_value(f_smooth, x):
         return f_smooth + l1 * jnp.sum(jnp.abs(x))
 
-    # Absolute tolerances off the zero state (reference Optimizer.scala:181).
-    f_zero, g_zero, _ = eval_smooth(jnp.zeros_like(x0))
-    pg_zero = pseudo_gradient(jnp.zeros_like(x0), g_zero, l1)
-    loss_abs_tol = jnp.abs(f_zero) * config.tolerance
-    grad_abs_tol = jnp.linalg.norm(pg_zero) * config.tolerance
-
-    f0s, g0, carry0 = eval_smooth(x0)
-    f0 = full_value(f0s, x0)
-
-    init = _OWLQNState(
-        it=jnp.zeros((), jnp.int32),
-        x=x0,
-        f=f0,
-        g_smooth=g0,
-        s_hist=jnp.zeros((m, d), dtype),
-        y_hist=jnp.zeros((m, d), dtype),
-        rho=jnp.zeros((m,), dtype),
-        num_pairs=jnp.zeros((), jnp.int32),
-        pos=jnp.zeros((), jnp.int32),
-        reason=jnp.zeros((), jnp.int32),
-        loss_hist=jnp.full((t + 1,), f0, dtype),
-        gnorm_hist=jnp.full(
-            (t + 1,), jnp.linalg.norm(pseudo_gradient(x0, g0, l1)), dtype
-        ),
-        n_evals=jnp.asarray(2, jnp.int32),  # zero-state + initial point
-        n_passes=jnp.asarray(4, jnp.int32),
-        carry=carry0,
-    )
+    def make_init(x0: Array) -> _OWLQNState:
+        d = x0.shape[-1]
+        if has_box:
+            x0 = project_to_box(x0, config.lower_bounds, config.upper_bounds)
+        # Absolute tolerances off the zero state (Optimizer.scala:181).
+        f_zero, g_zero, _ = eval_smooth(jnp.zeros_like(x0))
+        pg_zero = pseudo_gradient(jnp.zeros_like(x0), g_zero, l1)
+        f0s, g0, carry0 = eval_smooth(x0)
+        f0 = full_value(f0s, x0)
+        return _OWLQNState(
+            it=jnp.zeros((), jnp.int32),
+            x=x0,
+            f=f0,
+            g_smooth=g0,
+            s_hist=jnp.zeros((m, d), dtype),
+            y_hist=jnp.zeros((m, d), dtype),
+            rho=jnp.zeros((m,), dtype),
+            num_pairs=jnp.zeros((), jnp.int32),
+            pos=jnp.zeros((), jnp.int32),
+            reason=jnp.zeros((), jnp.int32),
+            loss_hist=jnp.full((t + 1,), f0, dtype),
+            gnorm_hist=jnp.full(
+                (t + 1,), jnp.linalg.norm(pseudo_gradient(x0, g0, l1)), dtype
+            ),
+            n_evals=jnp.asarray(2, jnp.int32),  # zero-state + initial point
+            n_passes=jnp.asarray(4, jnp.int32),
+            loss_abs_tol=jnp.abs(f_zero) * config.tolerance,
+            grad_abs_tol=jnp.linalg.norm(pg_zero) * config.tolerance,
+            carry=carry0,
+        )
 
     def cond(s: _OWLQNState):
         return s.reason == ConvergenceReason.NOT_CONVERGED
@@ -282,8 +284,8 @@ def minimize_owlqn(
             value=f_new,
             prev_value=s.f,
             grad_norm=pg_new_norm,
-            loss_abs_tol=loss_abs_tol,
-            grad_abs_tol=grad_abs_tol,
+            loss_abs_tol=s.loss_abs_tol,
+            grad_abs_tol=s.grad_abs_tol,
             max_iterations=t,
             step_failed=~ls_ok,
         )
@@ -303,25 +305,143 @@ def minimize_owlqn(
             gnorm_hist=s.gnorm_hist.at[it].set(pg_new_norm),
             n_evals=s.n_evals + ls_iters,
             n_passes=n_passes,
+            loss_abs_tol=s.loss_abs_tol,
+            grad_abs_tol=s.grad_abs_tol,
             carry=carry_new,
         )
 
-    s = lax.while_loop(cond, body, init)
+    def finalize(s: _OWLQNState) -> OptimizeResult:
+        pg_final = pseudo_gradient(s.x, s.g_smooth, l1)
+        idx = jnp.arange(t + 1)
+        loss_hist = jnp.where(idx <= s.it, s.loss_hist, s.f)
+        gnorm_hist = jnp.where(
+            idx <= s.it, s.gnorm_hist, jnp.linalg.norm(pg_final)
+        )
+        return OptimizeResult(
+            x=s.x,
+            value=s.f,
+            gradient=pg_final,
+            iterations=s.it,
+            reason=s.reason,
+            loss_history=loss_hist,
+            grad_norm_history=gnorm_hist,
+            n_evals=s.n_evals,
+            n_hvp=jnp.zeros((), jnp.int32),
+            n_feature_passes=s.n_passes,
+        )
 
-    pg_final = pseudo_gradient(s.x, s.g_smooth, l1)
-    idx = jnp.arange(t + 1)
-    loss_hist = jnp.where(idx <= s.it, s.loss_hist, s.f)
-    gnorm_hist = jnp.where(idx <= s.it, s.gnorm_hist, jnp.linalg.norm(pg_final))
+    return make_init, cond, body, finalize
 
-    return OptimizeResult(
-        x=s.x,
-        value=s.f,
-        gradient=pg_final,
-        iterations=s.it,
-        reason=s.reason,
-        loss_history=loss_hist,
-        grad_norm_history=gnorm_hist,
-        n_evals=s.n_evals,
-        n_hvp=jnp.zeros((), jnp.int32),
-        n_feature_passes=s.n_passes,
+
+def minimize_owlqn(
+    value_and_grad: Callable[[Array], tuple[Array, Array]] | None,
+    x0: Array,
+    l1_weight: float,
+    config: OptimizerConfig = OptimizerConfig(),
+    *,
+    oracle: SmoothMarginOracle | None = None,
+) -> OptimizeResult:
+    """Minimize f(x) + l1_weight·‖x‖₁ where ``value_and_grad`` evaluates the
+    smooth part f. Returns the reference-shaped ``OptimizeResult`` (the
+    ``gradient`` field holds the pseudo-gradient at the solution).
+
+    With a ``SmoothMarginOracle`` each backtracking trial computes the
+    VALUE only (one feature pass — Armijo never needs the gradient) and
+    the accepted point's gradient comes from its carried margins with one
+    backward pass: trials+1 passes per iteration vs 2·trials black-box.
+    """
+    make_init, cond, body, finalize = _owlqn_machinery(
+        value_and_grad, l1_weight, config, oracle=oracle, dtype=x0.dtype
     )
+    s = lax.while_loop(cond, body, make_init(x0))
+    return finalize(s)
+
+
+class SegmentedOWLQN:
+    """Host-segmented OWL-QN: the identical solve re-dispatched in
+    bounded-iteration device programs.
+
+    Why: a single while-loop solve at high-dim sparse scale can run many
+    minutes inside ONE device program. On shared/relayed TPUs that is (a)
+    unkillable — a client timeout leaves the program occupying the chip —
+    and (b) subject to the transport's per-program execution limit, which
+    surfaces as `UNAVAILABLE: TPU device error` mid-solve. Segmenting
+    bounds every dispatch to ``segment_iters`` optimizer iterations; the
+    host re-dispatches until converged (one scalar sync per segment).
+    Segment boundaries are also natural checkpoint/preemption points.
+
+    The jitted init/segment/finalize take the problem data as an ARGUMENT
+    (via ``oracle_factory(data)`` built at trace time), never as a closure
+    constant: a closed-over batch lowers as dense literals baked into the
+    StableHLO module — at config-3 scale that ships ~0.5 GB of constants
+    to the (already slow) remote compiler and can duplicate the batch in
+    HBM. jax.jit's own cache keys on the argument shapes, so warm-up and
+    timed solves share one compile (the data-dependent tolerances ride
+    the state, not the trace).
+
+    The reference's Spark equivalent kills stragglers at task granularity
+    (SURVEY §5.3); this is the TPU-native analogue at optimizer-iteration
+    granularity.
+    """
+
+    def __init__(
+        self,
+        value_and_grad: Callable[[Array], tuple[Array, Array]] | None,
+        l1_weight: float,
+        config: OptimizerConfig = OptimizerConfig(),
+        *,
+        oracle_factory: Callable[[object], SmoothMarginOracle] | None = None,
+        segment_iters: int = 16,
+    ):
+        import jax
+
+        if segment_iters < 1:
+            raise ValueError(f"segment_iters={segment_iters} < 1")
+        if oracle_factory is not None and value_and_grad is not None:
+            raise ValueError(
+                "pass value_and_grad=None when oracle_factory is given"
+            )
+        self.segment_iters = segment_iters
+        self.last_num_segments = 0
+        k = segment_iters
+
+        def machinery(data, dtype):
+            oracle = (
+                oracle_factory(data) if oracle_factory is not None else None
+            )
+            return _owlqn_machinery(
+                value_and_grad, l1_weight, config, oracle=oracle, dtype=dtype
+            )
+
+        @jax.jit
+        def init_f(x0, data):
+            make_init, _, _, _ = machinery(data, x0.dtype)
+            return make_init(x0)
+
+        @jax.jit
+        def segment_f(s, data):
+            _, cond, body, _ = machinery(data, s.x.dtype)
+            it0 = s.it
+            return lax.while_loop(
+                lambda ss: cond(ss) & (ss.it - it0 < k), body, s
+            )
+
+        @jax.jit
+        def final_f(s, data):
+            _, _, _, finalize = machinery(data, s.x.dtype)
+            return finalize(s)
+
+        self._init_f, self._segment_f, self._final_f = (
+            init_f,
+            segment_f,
+            final_f,
+        )
+
+    def __call__(self, x0: Array, data: object = ()) -> OptimizeResult:
+        s = self._init_f(x0, data)
+        n_seg = 0
+        while int(s.reason) == int(ConvergenceReason.NOT_CONVERGED):
+            s = self._segment_f(s, data)
+            n_seg += 1
+        self.last_num_segments = n_seg
+        return self._final_f(s, data)

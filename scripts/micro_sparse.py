@@ -61,7 +61,7 @@ def main():
     ap.add_argument("--k", type=int, default=56)
     ap.add_argument("--window", type=int, default=512)
     ap.add_argument("--only", default=None,
-                    help="single case: m1,r1,r2,r3,p1,p2,s1,s2,s3")
+                    help="single case: m1,m2,r1,r2,r3,p1,p2,s1,s2,s3")
     args = ap.parse_args()
 
     def want(name):
@@ -103,6 +103,24 @@ def main():
             return jnp.sum(v[idx_d] * val_d, axis=-1)
 
         report("m1 gather matvec", timed(m1, mk_vs(4, d)), nnz * 8)
+
+    if want("m2"):
+        # within-row column sort is free at build time (row-sum invariant);
+        # measures whether XLA:TPU's gather lowering rewards locality
+        order = np.argsort(idx, axis=1, kind="stable")
+        idx_s = jax.device_put(
+            jnp.asarray(np.take_along_axis(idx, order, axis=1))
+        )
+        val_s = jax.device_put(
+            jnp.asarray(np.take_along_axis(val, order, axis=1))
+        )
+
+        @jax.jit
+        def m2(v):
+            return jnp.sum(v[idx_s] * val_s, axis=-1)
+
+        report("m2 gather matvec row-sorted", timed(m2, mk_vs(4, d)),
+               nnz * 8)
 
     if want("r1"):
         flat_idx = idx_d.reshape(-1)

@@ -214,3 +214,74 @@ def test_vmapped_lbfgs_batch_of_problems():
     res = jax.vmap(solve)(centers)
     np.testing.assert_allclose(res.x, centers, atol=1e-5)
     assert res.x.shape == (16, D)
+
+
+def test_segmented_owlqn_matches_single_program():
+    """SegmentedOWLQN (host-re-dispatched bounded segments — the
+    relay/preemption-safe driver for long solves) must match the
+    single-while-loop solve up to f32 reassociation, reuse its compiled
+    segment across calls, and converge by the same criteria."""
+    from photon_tpu.optimize.common import ConvergenceReason
+    from photon_tpu.optimize.owlqn import SegmentedOWLQN, minimize_owlqn
+
+    rng = np.random.default_rng(11)
+    A = jnp.asarray(rng.normal(size=(200, D)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=200).astype(np.float32))
+
+    def vg(x):
+        r = A @ x - b
+        return 0.5 * jnp.dot(r, r), A.T @ r
+
+    cfg = OptimizerConfig(max_iterations=60, tolerance=1e-9)
+    ref = jax.jit(
+        lambda x0: minimize_owlqn(vg, x0, 0.3, cfg)
+    )(jnp.zeros((D,), jnp.float32))
+    solver = SegmentedOWLQN(vg, 0.3, cfg, segment_iters=2)
+    seg = solver(jnp.zeros((D,), jnp.float32))
+    assert solver.last_num_segments >= 2  # actually segmented
+    assert int(seg.reason) != int(ConvergenceReason.NOT_CONVERGED)
+    np.testing.assert_allclose(
+        np.asarray(ref.x), np.asarray(seg.x), rtol=2e-4, atol=1e-5
+    )
+    # second call reuses the jit cache (same shapes → no recompile)
+    misses_before = solver._segment_f._cache_size()
+    seg2 = solver(jnp.full((D,), 0.05, jnp.float32))
+    assert solver._segment_f._cache_size() == misses_before
+    assert abs(float(seg2.value) - float(seg.value)) <= 1e-4 * abs(
+        float(seg.value)
+    ) + 1e-6
+
+
+def test_segmented_owlqn_oracle_factory_data_as_argument():
+    """Production path: the batch flows through __call__ as a jit argument
+    (oracle built at trace time), matching the closure-based
+    minimize_owlqn solve on the same GLM problem."""
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optimize.owlqn import SegmentedOWLQN, minimize_owlqn
+    from photon_tpu.types import LabeledBatch
+
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(300, D)).astype(np.float32)
+    y = (rng.uniform(size=300) < 0.5).astype(np.float32)
+    batch = LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros((300,), jnp.float32),
+        weights=jnp.ones((300,), jnp.float32),
+    )
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=0.5, l1_weight=0.1)
+    cfg = OptimizerConfig(max_iterations=40, tolerance=1e-8)
+    ref = jax.jit(
+        lambda b, x0: minimize_owlqn(
+            None, x0, 0.1, cfg, oracle=obj.smooth_margin_oracle(b)
+        )
+    )(batch, jnp.zeros((D,), jnp.float32))
+    solver = SegmentedOWLQN(
+        None, 0.1, cfg,
+        oracle_factory=obj.smooth_margin_oracle, segment_iters=4,
+    )
+    seg = solver(jnp.zeros((D,), jnp.float32), batch)
+    np.testing.assert_allclose(
+        np.asarray(ref.x), np.asarray(seg.x), rtol=5e-4, atol=1e-5
+    )
