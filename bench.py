@@ -182,6 +182,36 @@ PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_partial.json")
 
 
+def launch_config_worker(name: str, timeout_s: float, env=None):
+    """Run one config in a killable worker subprocess and parse its
+    BENCHCFG_JSON marker (shared with scripts/rerun_bench_configs.py).
+    Returns ``(detail, None)`` on success, ``(None, error_string)``
+    otherwise; the worker's stderr is passed through either way."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--config", name],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout >{timeout_s}s (killed)"
+    sys.stderr.write(out.stderr or "")
+    sys.stderr.flush()
+    marker = [
+        ln
+        for ln in (out.stdout or "").splitlines()
+        if ln.startswith("BENCHCFG_JSON: ")
+    ]
+    if out.returncode == 0 and marker:
+        return json.loads(marker[-1][len("BENCHCFG_JSON: "):])["detail"], None
+    return None, (
+        f"rc={out.returncode}; "
+        f"{(out.stderr or '').strip().splitlines()[-3:]}"
+    )
+
+
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -1135,42 +1165,18 @@ def run_orchestrator() -> int:
                 f"(timeout {timeout_s}s) ==="
             )
             t0 = time.perf_counter()
-            try:
-                out = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--config", name],
-                    capture_output=True,
-                    text=True,
-                    timeout=timeout_s,
-                    env=attempt_env,
+            detail, err = launch_config_worker(name, timeout_s, attempt_env)
+            if detail is not None:
+                results["configs"][name] = detail
+                ok = True
+                any_ok = True
+                _log(
+                    f"[bench] config {name} ok in "
+                    f"{time.perf_counter() - t0:.0f}s"
                 )
-                sys.stderr.write(out.stderr or "")
-                sys.stderr.flush()
-                marker = [
-                    ln
-                    for ln in (out.stdout or "").splitlines()
-                    if ln.startswith("BENCHCFG_JSON: ")
-                ]
-                if out.returncode == 0 and marker:
-                    parsed = json.loads(marker[-1][len("BENCHCFG_JSON: "):])
-                    results["configs"][name] = parsed["detail"]
-                    ok = True
-                    any_ok = True
-                    _log(
-                        f"[bench] config {name} ok in "
-                        f"{time.perf_counter() - t0:.0f}s"
-                    )
-                    break
-                err = (
-                    f"rc={out.returncode}; "
-                    f"{(out.stderr or '').strip().splitlines()[-3:]}"
-                )
-                _log(f"[bench] config {name} failed: {err}")
-                results["errors"][name] = err
-            except subprocess.TimeoutExpired:
-                err = f"timeout >{timeout_s}s (killed)"
-                _log(f"[bench] config {name} {err}")
-                results["errors"][name] = err
+                break
+            _log(f"[bench] config {name} failed: {err}")
+            results["errors"][name] = err
             if attempt + 1 < len(plans):
                 wait = 15 * (attempt + 1)
                 _log(f"[bench] retrying {name} in {wait}s")

@@ -328,31 +328,40 @@ class RandomEffectCoordinate(Coordinate):
                 pad_e(b.sample_pos, fill=dataset.num_samples),
                 dataset.num_samples,
             )
+            # placement wrapped against transient relay UNAVAILABLE: one
+            # flaky put must not kill a multi-minute coordinate build
+            from photon_tpu.util.device_retry import put_with_retry
+
             device_buckets.append(
-                _DeviceBucket(
-                    features=put_entities(
-                        jnp.asarray(pad_e(b.features), dtype=dtype)
-                    ),
-                    labels=put_entities(
-                        jnp.asarray(pad_e(b.labels), dtype=dtype)
-                    ),
-                    offsets=put_entities(
-                        jnp.asarray(pad_e(b.offsets), dtype=dtype)
-                    ),
-                    weights=put_entities(
-                        jnp.asarray(pad_e(b.weights), dtype=dtype)
-                    ),
-                    train_weights=put_entities(
-                        jnp.asarray(
-                            pad_e(b.weights * b.active_mask), dtype=dtype
+                put_with_retry(
+                    lambda b=b, pad_e=pad_e, sp_unique=sp_unique: (
+                        _DeviceBucket(
+                            features=put_entities(
+                                jnp.asarray(pad_e(b.features), dtype=dtype)
+                            ),
+                            labels=put_entities(
+                                jnp.asarray(pad_e(b.labels), dtype=dtype)
+                            ),
+                            offsets=put_entities(
+                                jnp.asarray(pad_e(b.offsets), dtype=dtype)
+                            ),
+                            weights=put_entities(
+                                jnp.asarray(pad_e(b.weights), dtype=dtype)
+                            ),
+                            train_weights=put_entities(
+                                jnp.asarray(
+                                    pad_e(b.weights * b.active_mask),
+                                    dtype=dtype,
+                                )
+                            ),
+                            sample_pos=put_entities(jnp.asarray(sp_unique)),
+                            pad_slots=int(
+                                np.sum(sp_unique >= dataset.num_samples)
+                            ),
+                            entity_ids=b.entity_ids,
+                            col_index=b.col_index,
                         )
-                    ),
-                    sample_pos=put_entities(jnp.asarray(sp_unique)),
-                    pad_slots=int(
-                        np.sum(sp_unique >= dataset.num_samples)
-                    ),
-                    entity_ids=b.entity_ids,
-                    col_index=b.col_index,
+                    )
                 )
             )
         return RandomEffectCoordinate(

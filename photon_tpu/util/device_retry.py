@@ -1,0 +1,50 @@
+"""Transient-device-error retry for host→device placement.
+
+Remote/relayed TPU transports occasionally fail a ``device_put`` with
+``UNAVAILABLE`` even though the chip recovers seconds later. For a GAME
+coordinate build that places dozens of bucket blocks over many minutes,
+one transient placement failure otherwise kills the whole training
+worker (observed: bench config 5 lost two 40-minute TPU attempts to a
+single mid-build UNAVAILABLE). The reference delegates exactly this
+class of failure to Spark task retry (SURVEY §5.3,
+spark/RDDLike.scala:26); this helper is the placement-granular TPU
+analogue.
+
+Only errors whose message matches a transient pattern are retried;
+everything else (shape errors, OOM, ...) propagates immediately.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Unavailable")
+_logger = logging.getLogger(__name__)
+
+
+def put_with_retry(fn, *, attempts: int = 3, backoff_s: float = 20.0):
+    """Run ``fn()`` (a placement thunk returning device array(s)), retrying
+    transient device errors with linear backoff. Returns fn's result."""
+    if attempts < 1:
+        raise ValueError(f"attempts={attempts} < 1")
+    last = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # jax.errors.JaxRuntimeError et al.
+            msg = str(e)
+            if not any(m in msg for m in _TRANSIENT_MARKERS):
+                raise
+            last = e
+            if attempt + 1 < attempts:
+                wait = backoff_s * (attempt + 1)
+                _logger.warning(
+                    "transient device placement error (attempt %d/%d), "
+                    "retrying in %.0fs: %s",
+                    attempt + 1,
+                    attempts,
+                    wait,
+                    msg.splitlines()[0][:200],
+                )
+                time.sleep(wait)
+    raise last

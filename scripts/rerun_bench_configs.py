@@ -3,9 +3,15 @@
 Used when a config's number from the full orchestrated run is tainted
 (relay memoization) or fell back to CPU on a transient relay error: each
 config runs in its own killable worker subprocess exactly as the
-orchestrator launches it, and an honest success REPLACES the stale entry.
-A TPU probe runs first; configs are skipped (stale entry kept) when the
-chip is unreachable.
+orchestrator launches it (shared ``bench.launch_config_worker``), and an
+honest TPU success REPLACES the stale entry. Budgets derive from
+bench.CONFIG_PLAN (+300 s standalone headroom). A TPU probe with a
+patient wait loop runs before each config — the relay wedges for tens of
+minutes after killed programs, and a worker launched against a wedged
+relay burns its whole timeout hanging in backend init.
+
+Exit status: 0 if every requested config was replaced with a TPU result,
+1 otherwise (stale entries kept — do NOT publish on rc=1).
 
 Usage: python scripts/rerun_bench_configs.py config1 [config2 ...]
 """
@@ -18,16 +24,14 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_BENCH = os.path.join(_REPO, "bench.py")
-_PARTIAL = os.path.join(_REPO, "BENCH_partial.json")
+sys.path.insert(0, _REPO)
 
-TIMEOUTS = {
-    "a1a_logistic_lbfgs": 900,
-    "linear_tron": 1500,
-    "sparse_poisson_owlqn": 2700,
-    "glmix_game_estimator": 2400,
-    "game_ctr_scale": 3600,
-}
+from bench import CONFIG_PLAN, launch_config_worker  # noqa: E402
+
+_PARTIAL = os.path.join(_REPO, "BENCH_partial.json")
+#: orchestrator budgets + headroom: a standalone rerun tolerates one cold
+#: compile-cache miss that the orchestrated attempt chain amortizes
+TIMEOUTS = {name: t + 300 for name, t, _ in CONFIG_PLAN}
 
 
 def probe() -> bool:
@@ -54,12 +58,14 @@ def main() -> int:
     if not names:
         print("usage: rerun_bench_configs.py CONFIG [CONFIG...]")
         return 2
+    unknown = [n for n in names if n not in TIMEOUTS]
+    if unknown:
+        print(f"unknown configs: {unknown}; known: {sorted(TIMEOUTS)}")
+        return 2
     wait_budget_s = float(os.environ.get("RERUN_WAIT_BUDGET_S", 5400))
     results = json.load(open(_PARTIAL))
+    replaced = 0
     for name in names:
-        # the relay wedges for tens of minutes after killed programs —
-        # wait it out (a worker launched against a wedged relay burns its
-        # whole timeout hanging in backend init)
         deadline = time.time() + wait_budget_s
         up = probe()
         while not up and time.time() < deadline:
@@ -73,48 +79,29 @@ def main() -> int:
                   flush=True)
             continue
         t0 = time.perf_counter()
-        timeout_s = TIMEOUTS.get(name, 1800)
+        timeout_s = TIMEOUTS[name]
         print(f"[rerun] === {name} (timeout {timeout_s}s) ===", flush=True)
-        try:
-            out = subprocess.run(
-                [sys.executable, _BENCH, "--config", name],
-                capture_output=True,
-                text=True,
-                timeout=timeout_s,
-            )
-        except subprocess.TimeoutExpired:
-            print(f"[rerun] {name} timeout >{timeout_s}s", flush=True)
+        detail, err = launch_config_worker(name, timeout_s)
+        if detail is None:
+            print(f"[rerun] {name} failed: {err}", flush=True)
             continue
-        sys.stderr.write(out.stderr or "")
-        sys.stderr.flush()
-        marker = [
-            ln
-            for ln in (out.stdout or "").splitlines()
-            if ln.startswith("BENCHCFG_JSON: ")
-        ]
-        if out.returncode == 0 and marker:
-            parsed = json.loads(marker[-1][len("BENCHCFG_JSON: "):])
-            detail = parsed["detail"]
-            if detail.get("backend") != "tpu":
-                print(f"[rerun] {name} ran on {detail.get('backend')}; "
-                      "keeping stale entry", flush=True)
-                continue
-            results["configs"][name] = detail
-            results.setdefault("rerun_note", {})[name] = (
-                "re-measured standalone (entropy-keyed inputs; "
-                "segmented dispatch where applicable)"
-            )
-            tmp = _PARTIAL + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(results, f, indent=None)
-            os.replace(tmp, _PARTIAL)
-            print(f"[rerun] {name} ok in {time.perf_counter() - t0:.0f}s",
-                  flush=True)
-        else:
-            tail = (out.stderr or "").strip().splitlines()[-3:]
-            print(f"[rerun] {name} failed rc={out.returncode}: {tail}",
-                  flush=True)
-    return 0
+        if detail.get("backend") != "tpu":
+            print(f"[rerun] {name} ran on {detail.get('backend')}; "
+                  "keeping stale entry", flush=True)
+            continue
+        results["configs"][name] = detail
+        results.setdefault("rerun_note", {})[name] = (
+            "re-measured standalone (entropy-keyed inputs; "
+            "segmented dispatch where applicable)"
+        )
+        tmp = _PARTIAL + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=None)
+        os.replace(tmp, _PARTIAL)
+        replaced += 1
+        print(f"[rerun] {name} ok in {time.perf_counter() - t0:.0f}s",
+              flush=True)
+    return 0 if replaced == len(names) else 1
 
 
 if __name__ == "__main__":

@@ -265,12 +265,14 @@ def test_objective_gradient_with_windows_matches_plain(monkeypatch):
     obj = GLMObjective(loss=LogisticLoss, l2_weight=0.5)
     v0, g0 = obj.value_and_gradient(jnp.asarray(w), batch(None))
     windows = build_column_windows(idx, val, d, window=32)
-    monkeypatch.setenv("PHOTON_SPARSE_RMATVEC", "onehot")
-    v1, g1 = obj.value_and_gradient(jnp.asarray(w), batch(windows))
-    assert float(v0) == pytest.approx(float(v1), rel=1e-6)
-    np.testing.assert_allclose(
-        np.asarray(g0), np.asarray(g1), rtol=1e-5, atol=1e-6
-    )
+    for impl in ("onehot", "prefix"):  # prefix = the TPU AUTO default
+        monkeypatch.setenv("PHOTON_SPARSE_RMATVEC", impl)
+        v1, g1 = obj.value_and_gradient(jnp.asarray(w), batch(windows))
+        assert float(v0) == pytest.approx(float(v1), rel=1e-6), impl
+        np.testing.assert_allclose(
+            np.asarray(g0), np.asarray(g1), rtol=1e-5, atol=1e-6,
+            err_msg=impl,
+        )
 
 
 def test_hessian_diagonal_with_windows_matches_plain(monkeypatch):
@@ -307,13 +309,15 @@ def test_hessian_diagonal_with_windows_matches_plain(monkeypatch):
         )
 
     obj = GLMObjective(loss=LogisticLoss, l2_weight=0.3, normalization=norm)
-    monkeypatch.setenv("PHOTON_SPARSE_RMATVEC", "onehot")
     d0 = obj.hessian_diagonal(jnp.asarray(w), batch(None))
     windows = build_column_windows(idx, val, d, window=32)
-    d1 = obj.hessian_diagonal(jnp.asarray(w), batch(windows))
-    np.testing.assert_allclose(
-        np.asarray(d0), np.asarray(d1), rtol=1e-4, atol=1e-5
-    )
+    for impl in ("onehot", "prefix"):  # prefix: worst case for cumsum
+        monkeypatch.setenv("PHOTON_SPARSE_RMATVEC", impl)
+        d1 = obj.hessian_diagonal(jnp.asarray(w), batch(windows))
+        np.testing.assert_allclose(
+            np.asarray(d0), np.asarray(d1), rtol=1e-4, atol=1e-5,
+            err_msg=impl,
+        )
 
 
 def test_bf16_sparse_values_end_to_end(monkeypatch):

@@ -105,3 +105,32 @@ def test_prepare_output_dir(tmp_path):
 def test_trace_phase_noop():
     with trace_phase("anything"):
         pass
+
+
+def test_put_with_retry_transient_then_success(caplog):
+    """Transient UNAVAILABLE placements retry with backoff; other errors
+    propagate immediately (photon_tpu/util/device_retry.py)."""
+    from photon_tpu.util.device_retry import put_with_retry
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: TPU backend setup/compile error")
+        return "ok"
+
+    assert put_with_retry(flaky, attempts=3, backoff_s=0.0) == "ok"
+    assert calls["n"] == 3
+
+    def hard():
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError):
+        put_with_retry(hard, attempts=3, backoff_s=0.0)
+
+    def always():
+        raise RuntimeError("UNAVAILABLE: still down")
+
+    with pytest.raises(RuntimeError):
+        put_with_retry(always, attempts=2, backoff_s=0.0)
