@@ -243,8 +243,14 @@ def _probe_tpu(attempts: int = 3, timeout_s: float = 180.0):
             took = time.perf_counter() - t0
             if out.returncode == 0 and "PROBE_OK" in out.stdout:
                 line = out.stdout.strip().splitlines()[-1]
-                _log(f"[bench] TPU probe ok in {took:.0f}s: {line}")
-                return line.split(" ", 2)[2]
+                parts = line.split(" ", 2)
+                if len(parts) == 3 and parts[1] == "tpu":
+                    _log(f"[bench] TPU probe ok in {took:.0f}s: {line}")
+                    return parts[2]
+                _log(
+                    f"[bench] probe reached a non-TPU backend ({line}); "
+                    "treating as TPU-unreachable"
+                )
             _log(
                 f"[bench] TPU probe attempt {attempt + 1}/{attempts} failed "
                 f"(rc={out.returncode}, {took:.0f}s): "
@@ -278,16 +284,16 @@ def _init_backend():
 
     devs = jax.devices()
     if devs[0].platform == "tpu":
-        try:  # persistent compile cache makes per-config TPU retries cheap
-            # (skipped on CPU: XLA:CPU AOT caching is machine-feature
-            # sensitive and warns/SIGILLs across differing hosts)
-            cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 ".jax_cache")
-            jax.config.update("jax_compilation_cache_dir", cache)
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        except Exception as e:  # cache flags vary across jax versions
-            _log(f"[bench] compile cache unavailable: {e}")
+        # persistent compile cache makes per-config TPU retries cheap
+        # (skipped on CPU: XLA:CPU AOT caching is machine-feature
+        # sensitive and warns/SIGILLs across differing hosts)
+        from photon_tpu.util.compile_cache import enable_persistent_cache
+
+        if not enable_persistent_cache(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+        ):
+            _log("[bench] compile cache unavailable")
     jax.block_until_ready(jnp.zeros((8, 8)) @ jnp.zeros((8, 8)))
     return devs[0].platform, devs[0].device_kind
 

@@ -1,0 +1,33 @@
+"""Persistent XLA compile cache setup, shared by bench and measurement
+scripts.
+
+Remote compiles through the TPU relay run 40–140 s at 2^18 shapes and
+minutes at 2^20, so every entry this cache saves is the difference
+between a retry that resumes in seconds and one that burns its whole
+worker timeout recompiling. One function so the three call sites
+(bench worker init, micro_sparse, probe_ops_tpu) cannot drift.
+"""
+from __future__ import annotations
+
+import logging
+
+_logger = logging.getLogger(__name__)
+
+
+def enable_persistent_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Returns True when the cache was enabled. Never raises: the cache
+    flag names vary across jax versions, and a measurement run without
+    a cache beats no measurement run.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        return True
+    except Exception as e:  # pragma: no cover - version skew only
+        _logger.warning("persistent compile cache unavailable: %s", e)
+        return False

@@ -26,9 +26,14 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
     import jax as _jax  # sitecustomize force-selects the axon relay
@@ -69,6 +74,16 @@ def main():
 
     import jax
     import jax.numpy as jnp
+
+    if jax.devices()[0].platform == "tpu":
+        # Persistent compile cache (shared with bench.py): remote compiles
+        # at 2^20 shapes run minutes, so without this a case timeout cannot
+        # distinguish "slow op" from "slow compile" across retries.
+        from photon_tpu.util.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache"))
 
     n, d, k, w = 1 << args.n, 1 << args.d, args.k, args.window
     nnz = n * k
@@ -152,10 +167,6 @@ def main():
         report("r2 sorted segment_sum", timed(r2, mk_vs(4, n)), nnz * 12)
 
     if want("r3") or want("p1") or want("p2"):
-        import sys
-
-        sys.path.insert(0, os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
         from photon_tpu.ops.sparse_windows import (
             build_column_windows,
             rmatvec_windows_onehot,

@@ -36,6 +36,16 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    if jax.devices()[0].platform == "tpu":
+        # Shared persistent compile cache (see bench.py._init_backend):
+        # makes repeated probes pay the multi-minute 2^20 remote compile
+        # at most once.
+        from photon_tpu.util.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache"))
+
     reps = args.reps
     n, d, k = 1 << args.n, 1 << args.d, args.k
     rng = np.random.default_rng(0)

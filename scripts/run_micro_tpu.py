@@ -23,11 +23,13 @@ _MICRO = os.path.join(
 #: (case, n_log2_override or None, timeout_s) — safest → riskiest
 PLAN = [
     ("s3", None, 240),   # gather (riskless, answers the gather question)
-    ("m1", None, 240),   # ELL gather matvec
+    ("p2", None, 600),   # prefix-sum rmatvec — the production AUTO route
+    ("m1", None, 600),   # ELL gather matvec (compile at 2^20 runs minutes)
     ("s2", None, 240),   # sorted grouped segment_sum
     ("s1", None, 300),   # unique vs colliding permutation scatter
-    ("p1", None, 420),   # production Pallas kernel
-    ("r3", None, 420),   # XLA scan variant
+    ("p1", None, 600),   # windowed Pallas kernel
+    ("r3", 17, 420),     # XLA scan variant (2^20 known >420 s — r3 at full
+                         #   n wedged the relay for every case after it)
     ("r2", 17, 300),     # sorted segment_sum at reduced n
     ("r1", 15, 240),     # unsorted segment_sum, SMALL n (wedge risk)
 ]
