@@ -223,7 +223,10 @@ def _log(msg: str) -> None:
 _PROBE_SRC = (
     "import jax, jax.numpy as jnp\n"
     "d = jax.devices()\n"
-    "jax.block_until_ready(jnp.zeros((128, 128)) @ jnp.zeros((128, 128)))\n"
+    # float() read-back, not block_until_ready: over the relay the latter
+    # returns at enqueue, which would pass the probe on a wedged chip
+    "s = float(jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128))))\n"
+    "assert s == 128.0 * 128 * 128, s\n"
     "print('PROBE_OK', d[0].platform, d[0].device_kind, flush=True)\n"
 )
 
@@ -294,7 +297,8 @@ def _init_backend():
                          ".jax_cache")
         ):
             _log("[bench] compile cache unavailable")
-    jax.block_until_ready(jnp.zeros((8, 8)) @ jnp.zeros((8, 8)))
+    # read-back, not block_until_ready: proves the backend actually executes
+    float(jnp.sum(jnp.ones((8, 8)) @ jnp.ones((8, 8))))
     return devs[0].platform, devs[0].device_kind
 
 
@@ -306,6 +310,30 @@ def _peak_for(device_kind: str, platform: str):
         if key in kind:
             return peak, dtype
     return None, None
+
+
+def _digest_wrap(fn):
+    """Wrap a pytree-returning function so the jitted wrapper ALSO returns
+    an in-program scalar with a data dependence on every leaf; timing
+    ``float(digest)`` then bounds the REAL device execution with a single
+    round trip. Necessary because ``block_until_ready`` over the relay
+    returns at enqueue (util/force.py): r4 measured an 8.8-TFLOP program
+    "blocking" in 0.1 ms while its fetched-scalar twin took 127 ms."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def wrapped(*args):
+        out = fn(*args)
+        dig = jnp.float32(0)
+        for leaf in jax.tree_util.tree_leaves(out):
+            if hasattr(leaf, "dtype") and getattr(leaf, "size", 0):
+                dig = dig + jnp.asarray(leaf).reshape(-1)[0].astype(
+                    jnp.float32
+                )
+        return out, dig
+
+    return wrapped
 
 
 def _timed_run(fn, key):
@@ -322,14 +350,19 @@ def _timed_run(fn, key):
     The key is folded with fresh wall-clock entropy first: the relay's
     memoization PERSISTS ACROSS SESSIONS, so a fixed seed replays a cache
     hit from a previous round's identical program — r4 observed a 0.1 ms
-    "wall" for a whole L-BFGS solve, under the 72 ms dispatch floor."""
+    "wall" for a whole L-BFGS solve, under the 72 ms dispatch floor.
+
+    The wall is closed by fetching the digest scalar (``_digest_wrap``),
+    never by block_until_ready — which returns at enqueue over the relay
+    and yields walls that exclude the device execution entirely."""
     import contextlib
 
     import jax
 
     key = jax.random.fold_in(key, time.time_ns() & 0x7FFFFFFF)
     k_warm, k_timed = jax.random.split(key)
-    jax.block_until_ready(fn(k_warm))
+    forced = _digest_wrap(fn)
+    float(forced(k_warm)[1])
     prof_dir = os.environ.get("BENCH_PROFILE", "").strip()
     ctx = (
         jax.profiler.trace(prof_dir)
@@ -338,8 +371,8 @@ def _timed_run(fn, key):
     )
     with ctx:
         t0 = time.perf_counter()
-        out = fn(k_timed)
-        jax.block_until_ready(out)
+        out, dig = forced(k_timed)
+        float(dig)
         wall = time.perf_counter() - t0
     return out, wall
 
@@ -562,7 +595,9 @@ def config_sparse_poisson(peak_flops, scale):
         weights=jnp.ones((n,), dtype),
         windows=windows,
     )
-    jax.block_until_ready(batch.labels)
+    from photon_tpu.util.force import force
+
+    force(batch)  # read-back barrier: enqueue-async device_put otherwise
     upload_s = time.perf_counter() - t0
     win_stats = None
     if windows is not None:
@@ -613,8 +648,10 @@ def config_sparse_poisson(peak_flops, scale):
             weights=jnp.ones((cal_n,), dtype),
             windows=cal_windows,
         )
-        cal_run = make_run(OptimizerConfig(max_iterations=2, tolerance=0.0))
-        jax.block_until_ready(cal_run(cal_batch, jnp.zeros((d,), dtype)))
+        cal_run = _digest_wrap(
+            make_run(OptimizerConfig(max_iterations=2, tolerance=0.0))
+        )
+        float(cal_run(cal_batch, jnp.zeros((d,), dtype))[1])
         # entropy-fold: the relay memoizes identical (executable, inputs)
         # ACROSS SESSIONS — a fixed seed replays last round's cached result
         # and the gate projects from a fantasy 0.0 s calibration
@@ -623,8 +660,8 @@ def config_sparse_poisson(peak_flops, scale):
         )
         w0c = 1e-6 * jax.random.normal(cal_key, (d,), dtype)
         t0 = time.perf_counter()
-        cal_res = cal_run(cal_batch, w0c)
-        jax.block_until_ready(cal_res)
+        cal_res, cal_dig = cal_run(cal_batch, w0c)
+        float(cal_dig)
         cal_wall = time.perf_counter() - t0
         cal_evals = max(int(cal_res.n_evals), 1)
         evals_per_iter = cal_evals / max(int(cal_res.iterations), 1)
@@ -693,15 +730,18 @@ def config_sparse_poisson(peak_flops, scale):
         run = make_run(cfg)
     # warm on zeros, time from a different (≈identical-work) start point —
     # distinct inputs (entropy-folded key) defeat the relay's cross-session
-    # re-execution memoization
-    jax.block_until_ready(run(batch, jnp.zeros((d,), dtype)))
+    # re-execution memoization. Walls close with a read-back (force), not
+    # block_until_ready — the latter returns at enqueue over the relay.
+    # For the segmented path the final state depends on every segment
+    # program, so forcing the last result bounds the whole chain.
+    force(run(batch, jnp.zeros((d,), dtype)))
     w0_key = jax.random.fold_in(
         jax.random.PRNGKey(30), time.time_ns() & 0x7FFFFFFF
     )
     w0 = 1e-6 * jax.random.normal(w0_key, (d,), dtype)
     t0 = time.perf_counter()
     res = run(batch, w0)
-    jax.block_until_ready(res)
+    force((res.x, res.n_evals, res.n_feature_passes))
     wall = time.perf_counter() - t0
     if segment_iters is not None:
         _log(f"[bench] config3 segments run: {solver.last_num_segments}")

@@ -18,9 +18,8 @@ import logging
 import time
 from typing import Callable, Mapping, Sequence
 
-import jax.numpy as jnp
-
 from photon_tpu.game.coordinate import Coordinate
+from photon_tpu.util.force import force
 
 logger = logging.getLogger(__name__)
 
@@ -91,7 +90,10 @@ def run_coordinate_descent(
             total = total - scores[cid] + new_score
             scores[cid] = new_score
             states[cid] = new_state
-            jnp.asarray(new_score).block_until_ready()
+            # block_until_ready can return at enqueue over the relay
+            # (util/force.py) — a read-back is the only honest boundary
+            # for the per-coordinate seconds the tracker reports.
+            force(new_score)
             elapsed = time.perf_counter() - t0
             tracker.append(
                 {

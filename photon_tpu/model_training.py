@@ -24,6 +24,7 @@ from photon_tpu.data.dataset import (
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel, model_for_task
 from photon_tpu.ops.normalization import NormalizationContext
+from photon_tpu.util.force import force
 from photon_tpu.optimize.common import OptimizeResult
 from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
 from photon_tpu.types import Array, LabeledBatch, SparseBatch
@@ -113,7 +114,7 @@ def train_glm_grid(
 
         t0 = time.perf_counter()
         result = problem.solve(solve_batch, w)
-        result.x.block_until_ready()
+        force(result.x)  # read-back: block_until_ready can return at enqueue
         wall = time.perf_counter() - t0
 
         variances_t = problem.variances(batch, result.x)

@@ -1,0 +1,51 @@
+"""Completion barrier that works over enqueue-async device backends.
+
+``jax.block_until_ready`` over the relay-tunnelled TPU backend can return
+at ENQUEUE time: r4 measured an 8.8-TFLOP chained-matmul program
+"blocking" in 0.1 ms (a physically impossible 10.7 TB/s for the op it
+bounded) while the same program reduced to a fetched scalar took 127 ms.
+Compiles are enqueue-async too — a wall bounded only by
+``block_until_ready`` can exclude the remote compile it triggered. The
+only reliable barrier is a device→host READ of bytes that depend on the
+computation: the transfer cannot complete until the program has run.
+
+``force`` reads ONE element per array leaf (whole leaf when tiny), so its
+cost is a round trip per leaf (~70 ms over the relay), not a function of
+the data size. Use it to close any timed region; for tight in-jit
+measurement prefer reducing the program to a scalar and timing
+``float(...)`` (see bench.py's digest wrapper), which pays a single
+round trip total.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["force"]
+
+
+def force(tree: Any) -> None:
+    """Block until every jax.Array leaf of ``tree`` has actually been
+    computed, by reading back one element of each. The per-leaf slices are
+    enqueued (async, cheap) and concatenated into a single fetch so the
+    blocking round trip is paid ONCE, not per leaf. No-op for non-device
+    leaves (numpy arrays need no barrier)."""
+    import jax.numpy as jnp
+
+    leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if isinstance(leaf, jax.Array) and int(getattr(leaf, "size", 0))
+    ]
+    if not leaves:
+        return
+    if len(leaves) == 1:
+        np.asarray(leaves[0].reshape(-1)[0:1])
+        return
+    np.asarray(
+        jnp.concatenate(
+            [leaf.reshape(-1)[0:1].astype(jnp.float32) for leaf in leaves]
+        )
+    )

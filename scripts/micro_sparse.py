@@ -45,16 +45,28 @@ def timed(fn, args_list):
     """Warm on args_list[0], then time each remaining arg-tuple (distinct
     inputs defeat relay-side result memoization); returns median seconds.
     Prints the warm (compile+first-run) wall so a pathological lowering is
-    distinguishable from slow steady state."""
+    distinguishable from slow steady state.
+
+    Every wall closes with a scalar READ-BACK of the output, never
+    block_until_ready: over the relay the latter returns at enqueue — r4
+    measured 0.07 ms (an impossible 10.7 TB/s) for a 58M-nnz rmatvec that
+    way. The read-back adds the ~72 ms round trip to each wall, so
+    single-dispatch numbers are floor + op; confirm anything interesting
+    with scripts/probe_ops_tpu.py's in-program scan amortization."""
     import jax
 
+    from photon_tpu.util.force import force
+
+    def run_forced(args):
+        force(fn(*args))
+
     t0 = time.perf_counter()
-    jax.block_until_ready(fn(*args_list[0]))
+    run_forced(args_list[0])
     print(f"    [warm/compile {time.perf_counter() - t0:.1f}s]", flush=True)
     outs = []
     for args in args_list[1:]:
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        run_forced(args)
         outs.append(time.perf_counter() - t0)
     return float(np.median(outs))
 
@@ -100,24 +112,37 @@ def main():
         print(f"{name:28s} {t*1e3:9.2f} ms   "
               f"{bytes_moved / t / 1e9:8.1f} GB/s", flush=True)
 
+    # session-unique jitter: the relay memoizes identical (executable,
+    # inputs) pairs ACROSS SESSIONS — a fixed seed would replay a previous
+    # run's cached outputs and time the round-trip floor, not the op
+    session_eps = np.float32(((time.time_ns() % 997) + 1) * 1e-7)
+
     def mk_vs(m, shape):
-        return [(jnp.asarray(rng.standard_normal(shape).astype(np.float32)),)
-                for _ in range(m)]
+        return [
+            (jnp.asarray(
+                rng.standard_normal(shape).astype(np.float32) + session_eps
+            ),)
+            for _ in range(m)
+        ]
+
+    from photon_tpu.util.force import force
 
     if want("m1") or want("r1"):
         t0 = time.perf_counter()
         idx_d = jax.device_put(jnp.asarray(idx))
         val_d = jax.device_put(jnp.asarray(val))
-        jax.block_until_ready((idx_d, val_d))
+        force((idx_d, val_d))  # read-back: device_put is enqueue-async
         print(f"  [upload {nnz * 8 / 1e6:.0f} MB in "
               f"{time.perf_counter() - t0:.1f}s]", flush=True)
 
     if want("m1"):
         @jax.jit
-        def m1(v):
-            return jnp.sum(v[idx_d] * val_d, axis=-1)
+        def m1(ix, vl, v):
+            return jnp.sum(v[ix] * vl, axis=-1)
 
-        report("m1 gather matvec", timed(m1, mk_vs(4, d)), nnz * 8)
+        report("m1 gather matvec",
+               timed(m1, [(idx_d, val_d, v) for (v,) in mk_vs(4, d)]),
+               nnz * 8)
 
     if want("m2"):
         # within-row column sort is free at build time (row-sum invariant);
@@ -141,12 +166,14 @@ def main():
         flat_idx = idx_d.reshape(-1)
 
         @jax.jit
-        def r1(r):
+        def r1(vl, fi, r):
             return jax.ops.segment_sum(
-                (val_d * r[:, None]).reshape(-1), flat_idx, num_segments=d
+                (vl * r[:, None]).reshape(-1), fi, num_segments=d
             )
 
-        report("r1 unsorted segment_sum", timed(r1, mk_vs(4, n)), nnz * 8)
+        report("r1 unsorted segment_sum",
+               timed(r1, [(val_d, flat_idx, v) for (v,) in mk_vs(4, n)]),
+               nnz * 8)
 
     if want("r2"):
         order = np.argsort(idx.reshape(-1), kind="stable")
@@ -157,14 +184,17 @@ def main():
         sorted_val = jax.device_put(jnp.asarray(val.reshape(-1)[order]))
 
         @jax.jit
-        def r2(r):
-            contrib = sorted_val * r[row_of]
+        def r2(sv, sc, ro, r):
+            contrib = sv * r[ro]
             return jax.ops.segment_sum(
-                contrib, sorted_cols, num_segments=d,
+                contrib, sc, num_segments=d,
                 indices_are_sorted=True,
             )
 
-        report("r2 sorted segment_sum", timed(r2, mk_vs(4, n)), nnz * 12)
+        report("r2 sorted segment_sum",
+               timed(r2, [(sorted_val, sorted_cols, row_of, v)
+                          for (v,) in mk_vs(4, n)]),
+               nnz * 12)
 
     if want("r3") or want("p1") or want("p2"):
         from photon_tpu.ops.sparse_windows import (
@@ -175,18 +205,30 @@ def main():
         )
 
         t0 = time.perf_counter()
-        windows = build_column_windows(idx, val, d, window=args.window)
+        windows = build_column_windows(idx, val, d, window=args.window,
+                                       host=True)
         wi, length = windows.rows.shape
         print(f"windows: {wi} instances x {length} (width {args.window}) "
               f"waste={1 - nnz / (wi * length):.3f} "
               f"build={time.perf_counter() - t0:.1f}s", flush=True)
+        # Pass the layout as a jit ARGUMENT (production shape: it rides in
+        # SparseBatch). Closing over the host numpy arrays embeds ~800 MB
+        # of literal constants in the HLO shipped to the remote compile
+        # service — observed as a >19-minute compile hang at 2^20.
+        t0 = time.perf_counter()
+        windows = jax.device_put(windows)
+        force(windows)  # read-back: device_put is enqueue-async too
+        layout_mb = sum(a.nbytes for a in windows if a is not None) / 1e6
+        print(f"  [layout upload {layout_mb:.0f} MB in "
+              f"{time.perf_counter() - t0:.1f}s]", flush=True)
 
         if want("r3"):
             @jax.jit
-            def r3(r):
-                return rmatvec_windows_onehot(windows, r, d)
+            def r3(w, r):
+                return rmatvec_windows_onehot(w, r, d)
 
-            report("r3 windowed one-hot scan", timed(r3, mk_vs(4, n)),
+            report("r3 windowed one-hot scan",
+                   timed(r3, [(windows, v) for (v,) in mk_vs(4, n)]),
                    nnz * 12)
 
         if want("p1"):
@@ -195,18 +237,20 @@ def main():
                       flush=True)
             else:
                 @jax.jit
-                def p1(r):
-                    return rmatvec_windows_pallas(windows, r, d)
+                def p1(w, r):
+                    return rmatvec_windows_pallas(w, r, d)
 
-                report("p1 windowed one-hot Pallas", timed(p1, mk_vs(4, n)),
+                report("p1 windowed one-hot Pallas",
+                       timed(p1, [(windows, v) for (v,) in mk_vs(4, n)]),
                        nnz * 12)
 
         if want("p2"):
             @jax.jit
-            def p2(r):
-                return rmatvec_windows_prefix(windows, r, d)
+            def p2(w, r):
+                return rmatvec_windows_prefix(w, r, d)
 
-            report("p2 windowed prefix-sum", timed(p2, mk_vs(4, n)),
+            report("p2 windowed prefix-sum",
+                   timed(p2, [(windows, v) for (v,) in mk_vs(4, n)]),
                    nnz * 12)
 
     m = n

@@ -1,10 +1,18 @@
 """Dispatch-amortized op probe: true per-op device time via an in-program
 lax.scan loop (one dispatch for R reps), with a dense-matvec control.
 
-The single-dispatch micro numbers sit on a ~72 ms relay round-trip floor,
-which buries any op under ~100 ms — scanning R reps inside one program
-amortizes that floor to ~72/R ms per op. Each step folds its result back
-into the carry, so steps chain (no CSE) and every result stays live.
+Two relay measurement hazards this probe is built to defeat (PERF.md):
+  - the ~72 ms round-trip dispatch floor buries any op under ~100 ms —
+    scanning R reps inside one program amortizes it to ~72/R ms per op;
+  - ``block_until_ready`` over the relay can return at ENQUEUE time (r4:
+    a 58M-nnz rmatvec "measured" 0.07 ms = 10.7 TB/s), so every timed
+    program reduces to a SCALAR and the timer wraps ``float(...)`` — the
+    4-byte fetch cannot complete until the whole chained scan has run.
+
+Large operands are passed as jit ARGUMENTS, never closed over: tracing
+hoists closed-over numpy arrays into HLO literal constants, and shipping
+an 814 MB HLO to the remote compile service hung >19 min at config-3
+scale (same scale compiles in ~8 s as arguments).
 
 Usage: python scripts/probe_ops_tpu.py [--reps 8] [--n 18] [--case all]
 Cases: dense | m1 | p2 | p1 | all
@@ -29,7 +37,7 @@ def main():
     ap.add_argument("--n", type=int, default=18)
     ap.add_argument("--d", type=int, default=20)
     ap.add_argument("--k", type=int, default=56)
-    ap.add_argument("--window", type=int, default=512)
+    ap.add_argument("--window", type=int, default=128)
     ap.add_argument("--case", default="all")
     args = ap.parse_args()
 
@@ -50,27 +58,33 @@ def main():
     n, d, k = 1 << args.n, 1 << args.d, args.k
     rng = np.random.default_rng(0)
 
-    def scan_timed(step, x0, nbytes, label):
-        """step: x -> x (keeps data live); one jit program runs `reps`
-        steps. Reports wall / reps as per-op time — the ~72 ms dispatch
-        floor is amortized across reps, not subtracted."""
+    def scan_timed(step, x0, consts, nbytes, label):
+        """step: (x, *consts) -> x (keeps data live); one jit program runs
+        `reps` chained steps and returns a scalar. Timing wraps float(),
+        which forces the real device execution (see module docstring); the
+        dispatch floor is amortized across reps, not subtracted."""
 
         @jax.jit
-        def prog(x):
+        def prog(x, *cs):
             def body(c, _):
-                return step(c), 0.0
+                return step(c, *cs), None
 
             out, _ = jax.lax.scan(body, x, None, length=reps)
-            return out
+            return jnp.sum(out)
 
+        # Entropy-fold the start point: the relay memoizes identical
+        # (executable, inputs) re-executions ACROSS SESSIONS, so a fixed
+        # seed would replay a previous run's cached outputs into the
+        # read-back and time the round-trip floor instead of the op.
+        x0 = x0 + jnp.float32((time.time_ns() % 997) + 1) * jnp.float32(1e-7)
         t0 = time.perf_counter()
-        jax.block_until_ready(prog(x0))
+        float(prog(x0, *consts))
         warm = time.perf_counter() - t0
         walls = []
         for i in range(3):
             xi = x0 + jnp.float32(i + 1) * jnp.float32(1e-6)
             t0 = time.perf_counter()
-            jax.block_until_ready(prog(xi))
+            float(prog(xi, *consts))
             walls.append(time.perf_counter() - t0)
         wall = float(np.median(walls))
         per_op = wall / reps
@@ -92,11 +106,17 @@ def main():
         )
         v0 = jnp.asarray(rng.standard_normal(dd).astype(np.float32))
 
-        def dense_step(v):
-            y = a @ v
-            return y[:dd] * jnp.float32(1e-3) + v
+        def dense_step(v, a_):
+            y = a_ @ v
+            # fold ALL rows into the carry: without the sum, XLA's
+            # slice-of-dot rewrite could legally shrink the matvec to its
+            # first dd rows and the control would over-report by ~32x
+            return y[:dd] * jnp.float32(1e-3) + v + jnp.sum(y) * jnp.float32(
+                1e-9
+            )
 
-        scan_timed(dense_step, v0, nd * dd * 4, "dense matvec 2^17x4096")
+        scan_timed(dense_step, v0, (a,), nd * dd * 4,
+                   "dense matvec 2^17x4096")
 
     if args.case in ("m1", "all"):
         idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
@@ -105,11 +125,12 @@ def main():
         val_d = jax.device_put(jnp.asarray(val))
         v0 = jnp.asarray(rng.standard_normal(d).astype(np.float32))
 
-        def m1_step(v):
-            z = jnp.sum(v[idx_d] * val_d, axis=-1)
+        def m1_step(v, ix, vl):
+            z = jnp.sum(v[ix] * vl, axis=-1)
             return v.at[:n].add(z * jnp.float32(1e-6))
 
-        scan_timed(m1_step, v0, n * k * 8, f"m1 gather matvec 2^{args.n}")
+        scan_timed(m1_step, v0, (idx_d, val_d), n * k * 8,
+                   f"m1 gather matvec 2^{args.n}")
 
     if args.case in ("p2", "p1", "all"):
         from photon_tpu.ops.sparse_windows import (
@@ -121,30 +142,41 @@ def main():
         idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
         val = (rng.standard_normal((n, k)) / np.sqrt(k)).astype(np.float32)
         t0 = time.perf_counter()
-        windows = build_column_windows(idx, val, d, window=args.window)
+        windows = build_column_windows(idx, val, d, window=args.window,
+                                       host=True)
         wi, ln = windows.rows.shape
         print(
             f"windows: {wi}x{ln} w={args.window} build "
             f"{time.perf_counter() - t0:.1f}s",
             flush=True,
         )
+        t0 = time.perf_counter()
+        windows = jax.device_put(windows)
+        from photon_tpu.util.force import force
+
+        force(windows)  # read-back: device_put is enqueue-async too
+        print(f"  [layout upload {time.perf_counter() - t0:.1f}s]",
+              flush=True)
         r0 = jnp.asarray(rng.standard_normal(n).astype(np.float32))
 
         if args.case in ("p2", "all"):
 
-            def p2_step(r):
-                g = rmatvec_windows_prefix(windows, r, d)
-                return r.at[:1].add(g[0] * jnp.float32(1e-9))
+            def p2_step(r, w):
+                g = rmatvec_windows_prefix(w, r, d)
+                # sum keeps every output column live against slice-DCE
+                return r.at[:1].add(jnp.sum(g) * jnp.float32(1e-9))
 
-            scan_timed(p2_step, r0, n * k * 12, f"p2 prefix 2^{args.n}")
+            scan_timed(p2_step, r0, (windows,), n * k * 12,
+                       f"p2 prefix 2^{args.n}")
 
         if args.case in ("p1", "all") and dev.platform == "tpu":
 
-            def p1_step(r):
-                g = rmatvec_windows_pallas(windows, r, d)
-                return r.at[:1].add(g[0] * jnp.float32(1e-9))
+            def p1_step(r, w):
+                g = rmatvec_windows_pallas(w, r, d)
+                return r.at[:1].add(jnp.sum(g) * jnp.float32(1e-9))
 
-            scan_timed(p1_step, r0, n * k * 12, f"p1 pallas 2^{args.n}")
+            scan_timed(p1_step, r0, (windows,), n * k * 12,
+                       f"p1 pallas 2^{args.n}")
 
 
 if __name__ == "__main__":

@@ -59,6 +59,7 @@ from photon_tpu.optimize.problem import (  # noqa: E402
 )
 from photon_tpu.parallel.mesh import make_mesh  # noqa: E402
 from photon_tpu.types import TaskType  # noqa: E402
+from photon_tpu.util.force import force  # noqa: E402
 
 V5E_HBM_BYTES = 16 << 30  # one v5e chip
 
@@ -177,13 +178,13 @@ def main() -> None:
     t0 = time.perf_counter()
     residual = jnp.zeros((data.num_samples,), jnp.float32)
     state, _ = coord.train(residual, coord.initial_state())
-    jax.block_until_ready(state)
+    force(state)  # read-back: block_until_ready can return at enqueue
     report["train_s"] = round(time.perf_counter() - t0, 1)
     print(f"train {report['train_s']}s", flush=True)
 
     t0 = time.perf_counter()
     scores = coord.score(state)
-    jax.block_until_ready(scores)
+    force(scores)  # read-back barrier (util/force.py)
     report["score_s"] = round(time.perf_counter() - t0, 1)
     s_np = np.asarray(scores)
     assert np.all(np.isfinite(s_np))
@@ -228,7 +229,7 @@ def main() -> None:
         jnp.zeros((sub_data.num_samples,), jnp.float32),
         sub_coord.initial_state(),
     )
-    jax.block_until_ready(sub_state)
+    force(sub_state)
     # compare coefficients entity by entity (string entity keys)
     sub_lookup = {}
     for bucket, coefs in zip(sub_ds.buckets, sub_state):
