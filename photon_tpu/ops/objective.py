@@ -53,7 +53,11 @@ def matvec(batch, v: Array) -> Array:
     (ValueAndGradientAggregator.scala:36-80) on TPU.
     """
     if isinstance(batch, SparseBatch):
-        return jnp.sum(v[batch.indices] * batch.values, axis=-1)
+        from photon_tpu.ops.gather import take_1d
+
+        # take_1d: XLA:TPU's element gather serializes at ~110M elem/s;
+        # the chunked row-fetch form is bandwidth-bound (ops/gather.py)
+        return jnp.sum(take_1d(v, batch.indices) * batch.values, axis=-1)
     x = batch.features
     if x.dtype == jnp.bfloat16:
         return jax.lax.dot_general(

@@ -1,0 +1,195 @@
+"""Gather-floor lab: what beats XLA:TPU's serialized random gather?
+
+r4 on-chip finding (probe_ops_tpu.py): at config-3 scale (58M nnz,
+n=d=2^20) BOTH sparse directions sit on the same ~110M elem/s random
+gather — ELL forward matvec 519 ms (v[idx], 0.9 GB/s effective), windowed
+prefix/pallas rmatvec ~633 ms (r[rows] inside _contrib). The scatter
+cliff was fixed in r3; the gather floor is what remains.
+
+Cases (all scan-amortized, scalar-digest forced — see probe_ops_tpu.py
+for why block_until_ready cannot time anything over the relay):
+
+  e1  elementwise add         true achievable HBM rate control
+  gi  iota-index gather       best-case locality (pure gather overhead)
+  gs  sorted random gather    locality without structure
+  gr  random gather           the measured floor (m1's pattern)
+  gt  tiny-table gather       table fits a cache line budget (d=2^10)
+  gc  chunked row gather      v2d[idx>>7] fetches 128-lane rows (vector
+                              loads), lane-select via one-hot dot: trades
+                              128x bytes for vectorization
+  gl  take_along_axis lanes   within-row lane shuffle [M,128] — the
+                              primitive a permutation-network (block
+                              gather + local lane shuffle) would need
+
+Usage: python scripts/gather_lab.py [--slots 26] [--d 20] [--case all]
+--slots is log2 of gathered-element count (default 2^26 ≈ 67M ≈ config 3).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=26)
+    ap.add_argument("--d", type=int, default=20)
+    ap.add_argument("--reps", type=int, default=4)
+    ap.add_argument("--case", default="all")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform == "tpu":
+        from photon_tpu.util.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache"))
+
+    reps = args.reps
+    S, d = 1 << args.slots, 1 << args.d
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} {dev.platform} slots=2^{args.slots} "
+          f"table=2^{args.d} reps={reps}", flush=True)
+
+    def want(name):
+        return args.case in (name, "all")
+
+    def scan_timed(step, x0, consts, label, elems, nbytes):
+        @jax.jit
+        def prog(x, *cs):
+            def body(c, _):
+                return step(c, *cs), None
+
+            out, _ = jax.lax.scan(body, x, None, length=reps)
+            return jnp.sum(out)
+
+        x0 = x0 + jnp.float32((time.time_ns() % 997) + 1) * jnp.float32(1e-7)
+        t0 = time.perf_counter()
+        float(prog(x0, *consts))
+        warm = time.perf_counter() - t0
+        walls = []
+        for i in range(3):
+            xi = x0 + jnp.float32(i + 1) * jnp.float32(1e-6)
+            t0 = time.perf_counter()
+            float(prog(xi, *consts))
+            walls.append(time.perf_counter() - t0)
+        wall = float(np.median(walls))
+        per_op = wall / reps
+        print(
+            f"{label:30s} warm={warm:6.1f}s per_op={per_op * 1e3:8.2f} ms  "
+            f"{elems / per_op / 1e6:9.1f} Melem/s  "
+            f"{nbytes / per_op / 1e9:7.1f} GB/s",
+            flush=True,
+        )
+
+    if want("e1"):
+        a = jax.device_put(jnp.asarray(
+            rng.standard_normal(S).astype(np.float32)))
+
+        def e1_step(x, a_):
+            y = a_ + x[0]
+            return x.at[0].add(jnp.sum(y) * jnp.float32(1e-12))
+
+        # reads S f32 + writes S f32
+        scan_timed(e1_step, jnp.zeros((8,), jnp.float32), (a,),
+                   "e1 elementwise add", S, S * 8)
+
+    tbl = jax.device_put(jnp.asarray(
+        rng.standard_normal(d).astype(np.float32)))
+
+    def mk_idx(kind):
+        if kind == "iota":
+            return (np.arange(S, dtype=np.int64) % d).astype(np.int32)
+        x = rng.integers(0, d, size=S).astype(np.int32)
+        return np.sort(x) if kind == "sorted" else x
+
+    def gather_step_factory():
+        def step(x, t_, i_):
+            # t_ + x[0]: the gather must depend on the carry, or XLA can
+            # hoist the loop-invariant gather out of the scan and the
+            # probe times one gather amortized over `reps`
+            y = (t_ + x[0])[i_]
+            return x.at[0].add(jnp.sum(y) * jnp.float32(1e-12))
+
+        return step
+
+    for name, label in (("gi", "iota"), ("gs", "sorted"), ("gr", "random")):
+        if want(name):
+            idx = jax.device_put(jnp.asarray(mk_idx(
+                {"gi": "iota", "gs": "sorted", "gr": "random"}[name])))
+            scan_timed(gather_step_factory(), jnp.zeros((8,), jnp.float32),
+                       (tbl, idx), f"{name} gather {label} [2^{args.slots}]",
+                       S, S * 8)
+
+    if want("gt"):
+        dt = 1 << 10
+        tbl_t = jax.device_put(jnp.asarray(
+            rng.standard_normal(dt).astype(np.float32)))
+        idx_t = jax.device_put(jnp.asarray(
+            rng.integers(0, dt, size=S).astype(np.int32)))
+        scan_timed(gather_step_factory(), jnp.zeros((8,), jnp.float32),
+                   (tbl_t, idx_t), "gt gather tiny table d=2^10", S, S * 8)
+
+    if want("gc"):
+        # chunked: fetch whole 128-lane rows by block index, select the
+        # lane with a one-hot dot. Bytes = slots*512, but every load is a
+        # full vector register row.
+        tbl2d = tbl.reshape(-1, 128)
+        idx = jax.device_put(jnp.asarray(mk_idx("random")))
+
+        # segment the slot stream: an unfused gather would materialize
+        # [S, 128] f32 (34 GB at 2^26 slots) — 16 segments bound the
+        # worst-case intermediate at ~2 GB
+        seg = 16
+        seg_len = S // seg
+
+        def gc_step(x, t2_, i_):
+            t2x = t2_ + x[0]  # carry dependence defeats scan hoisting
+
+            def body(s, acc):
+                iseg = jax.lax.dynamic_slice(i_, (s * seg_len,), (seg_len,))
+                rows = t2x[iseg >> 7]            # [seg_len, 128] row loads
+                onehot = (
+                    (iseg & 127)[:, None]
+                    == jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+                ).astype(jnp.float32)
+                return acc + jnp.sum(rows * onehot)
+
+            tot = jax.lax.fori_loop(0, seg, body, jnp.float32(0))
+            return x.at[0].add(tot * jnp.float32(1e-12))
+
+        scan_timed(gc_step, jnp.zeros((8,), jnp.float32), (tbl2d, idx),
+                   "gc chunked row gather+onehot", S, S * 512)
+
+    if want("gl"):
+        # within-row lane shuffle: [M,128] rows each permuted by their own
+        # lane indices — the local stage of a permutation network
+        M = S // 128
+        mat = jax.device_put(jnp.asarray(
+            rng.standard_normal((M, 128)).astype(np.float32)))
+        lanes = jax.device_put(jnp.asarray(
+            np.argsort(rng.standard_normal((M, 128)), axis=1)
+            .astype(np.int32)))
+
+        def gl_step(x, m_, l_):
+            y = jnp.take_along_axis(m_ + x[0], l_, axis=1)
+            return x.at[0].add(jnp.sum(y) * jnp.float32(1e-12))
+
+        scan_timed(gl_step, jnp.zeros((8,), jnp.float32), (mat, lanes),
+                   "gl take_along_axis lanes", S, S * 8)
+
+
+if __name__ == "__main__":
+    main()

@@ -125,8 +125,11 @@ def main():
         val_d = jax.device_put(jnp.asarray(val))
         v0 = jnp.asarray(rng.standard_normal(d).astype(np.float32))
 
+        from photon_tpu.ops.gather import take_1d
+
         def m1_step(v, ix, vl):
-            z = jnp.sum(v[ix] * vl, axis=-1)
+            # production ELL matvec route (ops/gather.take_1d dispatch)
+            z = jnp.sum(take_1d(v, ix) * vl, axis=-1)
             return v.at[:n].add(z * jnp.float32(1e-6))
 
         scan_timed(m1_step, v0, (idx_d, val_d), n * k * 8,

@@ -1,0 +1,134 @@
+"""chunked_take: the TPU gather-cliff workaround (ops/gather.py).
+
+The strategy must be BIT-identical to the plain gather (one-hot lane
+select multiplies by exactly one 1.0), across table sizes that do and do
+not divide the 128-lane row width, and through the production routes
+(ELL matvec, windowed prefix rmatvec)."""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.ops.gather import _num_segments, chunked_take, take_1d
+from photon_tpu.ops.objective import matvec
+from photon_tpu.ops.sparse_windows import (
+    build_column_windows,
+    rmatvec_windows_prefix,
+)
+from photon_tpu.types import SparseBatch
+
+
+@pytest.mark.parametrize(
+    "d,shape",
+    [
+        (7, (5,)),              # table smaller than one lane row
+        (128, (64,)),           # exactly one row
+        (1000, (17, 3)),        # non-multiple of 128, 2-D indices
+        (1 << 14, (257, 9)),
+        ((1 << 15) + 5, (4096,)),
+    ],
+)
+def test_chunked_take_bit_identical(d, shape):
+    rng = np.random.default_rng(0)
+    t = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+    ix = jnp.asarray(rng.integers(0, d, size=shape).astype(np.int32))
+    assert np.array_equal(
+        np.asarray(chunked_take(t, ix)), np.asarray(t[ix])
+    )
+
+
+def test_chunked_take_under_jit_and_grad():
+    rng = np.random.default_rng(1)
+    t = jnp.asarray(rng.standard_normal(300).astype(np.float32))
+    ix = jnp.asarray(rng.integers(0, 300, size=(41,)).astype(np.int32))
+
+    f = jax.jit(lambda tt: jnp.sum(chunked_take(tt, ix) ** 2))
+    g = jax.grad(f)(t)
+    # d/dt sum(t[ix]^2) = 2 * segment_sum(t[ix]) scattered back
+    expect = np.zeros(300, np.float32)
+    np.add.at(expect, np.asarray(ix), 2.0 * np.asarray(t)[np.asarray(ix)])
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-6)
+
+
+def test_num_segments_bounds_fetch_for_any_slot_count():
+    # odd counts must segment too (a [slots, 128] f32 fetch at 31M odd
+    # slots is ~16 GB — past a v5e's HBM if segmentation silently bailed)
+    for n in [1, 8, 56 << 20, (1 << 23) * 7, 1_000_001 * 31, 3 * 5 * 7]:
+        segs = _num_segments(n)
+        per_seg = -(-n // segs) * 512
+        assert per_seg <= (1 << 30) + 512 * segs
+
+
+def test_chunked_take_odd_slot_count_segments():
+    rng = np.random.default_rng(5)
+    t = jnp.asarray(rng.standard_normal(777).astype(np.float32))
+    ix = jnp.asarray(rng.integers(0, 777, size=(1009,)).astype(np.int32))
+    import photon_tpu.ops.gather as gather_mod
+
+    orig = gather_mod._SEG_BYTES
+    try:
+        gather_mod._SEG_BYTES = 1 << 12  # force multi-segment + padding
+        out = chunked_take(t, ix)
+    finally:
+        gather_mod._SEG_BYTES = orig
+    assert np.array_equal(np.asarray(out), np.asarray(t[ix]))
+
+
+def test_chunked_take_nonfinite_isolation():
+    """An Inf/NaN table entry must affect only indices that SELECT it —
+    not its 128-lane block neighbors (0*Inf poisoning)."""
+    t = np.zeros(256, np.float32)
+    t[7] = np.inf
+    t[130] = np.nan
+    tj = jnp.asarray(t)
+    ix = jnp.asarray(np.array([0, 6, 8, 7, 129, 131, 130], np.int32))
+    out = np.asarray(chunked_take(tj, ix))
+    assert out[0] == 0 and out[1] == 0 and out[2] == 0
+    assert np.isinf(out[3])
+    assert out[4] == 0 and out[5] == 0
+    assert np.isnan(out[6])
+
+
+def test_take_1d_env_dispatch(monkeypatch):
+    rng = np.random.default_rng(2)
+    t = jnp.asarray(rng.standard_normal(500).astype(np.float32))
+    ix = jnp.asarray(rng.integers(0, 500, size=(99,)).astype(np.int32))
+    outs = {}
+    for impl in ("plain", "chunked", "auto"):
+        monkeypatch.setenv("PHOTON_SPARSE_GATHER", impl)
+        outs[impl] = np.asarray(take_1d(t, ix))
+    assert np.array_equal(outs["plain"], outs["chunked"])
+    assert np.array_equal(outs["plain"], outs["auto"])
+
+
+def test_production_routes_match_plain(monkeypatch):
+    """ELL matvec and windowed prefix rmatvec: chunked == plain exactly."""
+    rng = np.random.default_rng(3)
+    n, d, k = 256, 2048, 12
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.standard_normal((n, k)).astype(np.float32)
+    batch = SparseBatch(
+        indices=jnp.asarray(idx),
+        values=jnp.asarray(val),
+        labels=jnp.zeros((n,), jnp.float32),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+        windows=None,
+    )
+    v = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+    w = jax.device_put(build_column_windows(idx, val, d, window=128))
+    r = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+    results = {}
+    for impl in ("plain", "chunked"):
+        monkeypatch.setenv("PHOTON_SPARSE_GATHER", impl)
+        results[impl] = (
+            np.asarray(matvec(batch, v)),
+            np.asarray(rmatvec_windows_prefix(w, r, d)),
+        )
+    assert np.array_equal(results["plain"][0], results["chunked"][0])
+    assert np.array_equal(results["plain"][1], results["chunked"][1])
