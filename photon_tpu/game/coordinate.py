@@ -213,16 +213,24 @@ class FixedEffectCoordinate(Coordinate):
         return jnp.zeros((self.num_features,), dtype=self.dtype)
 
     @partial(jax.jit, static_argnums=0)
-    def _train_jit(self, residual_scores: Array, w0: Array, reg_weight: Array):
+    def _train_jit(
+        self, batch, residual_scores: Array, w0: Array, reg_weight: Array
+    ):
         # NOTE: only structural attrs of (static) self may be read here —
         # anything λ-dependent must arrive as a traced argument, or a later
         # in-place reweight would silently reuse the stale traced value.
-        b = self.batch._replace(offsets=self.batch.offsets + residual_scores)
+        # The batch rides as an ARGUMENT, never through static self: a
+        # trace-time constant lowers as HLO literals, and shipping a
+        # multi-hundred-MB module body to the remote compile service is
+        # rejected outright (HTTP 413 at CTR scale) or hangs it for
+        # minutes (PERF.md r4).
+        b = batch._replace(offsets=batch.offsets + residual_scores)
         res = self.problem.solve(b, w0, reg_weight)
         return res
 
     def train(self, residual_scores: Array, state: Array):
         res = self._train_jit(
+            self.batch,
             residual_scores,
             state,
             jnp.asarray(self.problem.config.regularization_weight, self.dtype),
@@ -230,14 +238,17 @@ class FixedEffectCoordinate(Coordinate):
         return res.x, res
 
     @partial(jax.jit, static_argnums=0)
-    def score(self, state: Array) -> Array:
-        """x·(w .* factor) + margin shift — the coordinate's contribution,
-        exclusive of data offsets (FixedEffectCoordinate.score:158-166)."""
+    def _score_jit(self, batch, state: Array) -> Array:
         eff = self.normalization.effective_coefficients(state)
-        s = matvec(self.batch, eff)
+        s = matvec(batch, eff)
         if self.normalization.shifts is not None:
             s = s + self.normalization.margin_shift(state)
         return s
+
+    def score(self, state: Array) -> Array:
+        """x·(w .* factor) + margin shift — the coordinate's contribution,
+        exclusive of data offsets (FixedEffectCoordinate.score:158-166)."""
+        return self._score_jit(self.batch, state)
 
     def to_model(self, state: Array) -> FixedEffectModel:
         w = self.normalization.model_to_original_space(state)
@@ -587,8 +598,18 @@ class MatrixFactorizationCoordinate(Coordinate):
 
     @partial(jax.jit, static_argnums=0)
     def _train_jit(
-        self, residual_scores: Array, u0: Array, v0: Array, l2_weight: Array
+        self,
+        data,
+        residual_scores: Array,
+        u0: Array,
+        v0: Array,
+        l2_weight: Array,
     ):
+        # data = (row_idx, col_idx, offsets, weights, labels) as ARGUMENTS,
+        # not via static self: trace-time constants lower as HLO literals
+        # and oversize the remote-compile request at scale (see
+        # FixedEffectCoordinate._train_jit).
+        row_idx, col_idx, base_offsets, weights, labels = data
         from photon_tpu.ops.losses import loss_for_task
         from photon_tpu.optimize.lbfgs import minimize_lbfgs
 
@@ -601,17 +622,15 @@ class MatrixFactorizationCoordinate(Coordinate):
             v = x[sizes[0] :].reshape(shapes[1])
             return u, v
 
-        offsets = self.offsets + residual_scores
+        offsets = base_offsets + residual_scores
 
         def value_and_grad(x):
             def value(x):
                 u, v = unpack(x)
                 margin = offsets + jnp.einsum(
-                    "nk,nk->n", u[self.row_idx], v[self.col_idx]
+                    "nk,nk->n", u[row_idx], v[col_idx]
                 )
-                data_term = jnp.sum(
-                    self.weights * loss.loss(margin, self.labels)
-                )
+                data_term = jnp.sum(weights * loss.loss(margin, labels))
                 reg = 0.5 * l2_weight * jnp.sum(x * x)
                 return data_term + reg
 
@@ -624,8 +643,18 @@ class MatrixFactorizationCoordinate(Coordinate):
         u, v = unpack(res.x)
         return u, v, res
 
+    def _data_args(self):
+        return (
+            self.row_idx,
+            self.col_idx,
+            self.offsets,
+            self.weights,
+            self.labels,
+        )
+
     def train(self, residual_scores: Array, state):
         u, v, res = self._train_jit(
+            self._data_args(),
             residual_scores,
             state[0],
             state[1],
@@ -634,10 +663,15 @@ class MatrixFactorizationCoordinate(Coordinate):
         return (u, v), res
 
     @partial(jax.jit, static_argnums=0)
-    def score(self, state) -> Array:
+    def _score_jit(self, row_idx, col_idx, weights, state) -> Array:
         u, v = state
-        s = jnp.einsum("nk,nk->n", u[self.row_idx], v[self.col_idx])
-        return jnp.where(self.weights > 0, s, 0.0)
+        s = jnp.einsum("nk,nk->n", u[row_idx], v[col_idx])
+        return jnp.where(weights > 0, s, 0.0)
+
+    def score(self, state) -> Array:
+        return self._score_jit(
+            self.row_idx, self.col_idx, self.weights, state
+        )
 
     def to_model(self, state) -> MatrixFactorizationModel:
         return MatrixFactorizationModel(

@@ -61,7 +61,14 @@ def chunked_take(table: Array, idx: Array) -> Array:
     lane select. Element-identical to the plain gather (the lane select
     uses ``where``, not multiply, so non-finite table entries do NOT
     poison their 128-lane neighbors through 0·Inf); ~3.2x faster on TPU
-    at random-sparse scale (module docstring)."""
+    at random-sparse scale (module docstring).
+
+    Precondition: every index lies in [0, d). Out-of-range indices follow
+    a DIFFERENT clamp than XLA's plain gather (block and lane clamp
+    separately instead of the flat index), so an upstream indexing bug
+    would produce backend-dependent values rather than a consistent
+    clamp — all production index streams (ELL layouts, window rows) are
+    built in-range by construction."""
     (d,) = table.shape
     n_rows = -(-d // 128)
     padded = jnp.zeros((n_rows * 128,), table.dtype).at[:d].set(table)
