@@ -175,7 +175,11 @@ CONFIG_PLAN = [
     # remote compiles through the relay are slow, so their budgets cover a
     # cold cache — retries resume from the persistent compile cache
     ("glmix_game_estimator", 2400, 2),
-    ("game_ctr_scale", 3600, 2),
+    # CTR scale compiles ~30 programs (per-bucket RE solves x 2
+    # coordinates); a COLD cache spent the whole former 3600 s budget in
+    # remote compiles alone (r4 attempt 2) — the retry then finishes fast
+    # from the persistent cache, but the first attempt needs the headroom
+    ("game_ctr_scale", 5400, 2),
 ]
 
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
