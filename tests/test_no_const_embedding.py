@@ -1,0 +1,182 @@
+"""Regression guard: hot jit programs must not embed data as constants.
+
+Closed-over arrays (numpy or jax.Array) lower as HLO literal constants.
+Over the relay-tunnelled TPU backend that means the data is serialized
+INTO the module shipped to the remote compile service: observed r4 as
+HTTP 413 rejections at ~256 MB and a >19-minute compile hang at 814 MB
+(PERF.md). The contract is that batches/buckets/index streams ride as
+jit ARGUMENTS; this test traces each hot entry point and fails if any
+jaxpr constant is larger than a scalar-ish epsilon, naming the offender.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.coordinate import build_coordinate
+from photon_tpu.game.data import GameData
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.types import TaskType
+
+#: anything bigger than this many bytes in a traced program's consts is a
+#: data array smuggled through a closure, not a tolerable scalar table
+_CONST_BYTES_LIMIT = 16 * 1024
+
+
+def _collect_consts(closed_jaxpr, out):
+    """Consts of this jaxpr AND of every nested ClosedJaxpr: a jitted
+    callee's closure constants live on the inner pjit equation's jaxpr —
+    the outer ``make_jaxpr`` consts list stays empty, so a non-recursive
+    check is vacuous for exactly the functions this guard protects."""
+    out.extend(closed_jaxpr.consts)
+    for eqn in closed_jaxpr.jaxpr.eqns:
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr") and hasattr(v, "consts"):  # ClosedJaxpr
+                _collect_consts(v, out)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                        _collect_consts(item, out)
+
+
+def _assert_no_large_consts(jaxpr, label):
+    consts: list = []
+    _collect_consts(jaxpr, consts)
+    offenders = [
+        (np.asarray(c).nbytes, getattr(c, "shape", None))
+        for c in consts
+        if hasattr(c, "nbytes") and np.asarray(c).nbytes > _CONST_BYTES_LIMIT
+    ]
+    assert not offenders, (
+        f"{label}: traced program embeds {offenders} as constants — pass "
+        "the data as jit arguments (HTTP 413 / remote-compile hang class, "
+        "PERF.md r4)"
+    )
+
+
+def test_guard_detects_planted_closure_constant():
+    """Meta-test: the walker must SEE a closure constant inside a jitted
+    callee — otherwise every other test in this file is vacuous."""
+    big = jnp.asarray(np.random.default_rng(0).normal(size=(64, 1024)),
+                      jnp.float32)  # 256 KB > limit
+
+    @jax.jit
+    def leaky(v):
+        return jnp.sum(big * v)
+
+    jaxpr = jax.make_jaxpr(lambda v: leaky(v))(jnp.float32(2.0))
+    consts: list = []
+    _collect_consts(jaxpr, consts)
+    sizes = [np.asarray(c).nbytes for c in consts if hasattr(c, "nbytes")]
+    assert any(s > _CONST_BYTES_LIMIT for s in sizes), (
+        "guard walker failed to find the planted 256 KB closure constant — "
+        "the embedding checks below prove nothing"
+    )
+
+
+def _game_fixture(n=512, fe_dim=64, users=32, d_re=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, fe_dim)).astype(np.float32)
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    ids = rng.integers(0, users, size=n)
+    from photon_tpu.game.data import CSRMatrix
+
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    data = GameData.build(
+        labels=labels,
+        feature_shards={
+            "global": CSRMatrix.from_dense(x),
+            "per_user": CSRMatrix.from_dense(x_re),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=3),
+        regularization=RegularizationContext(RegularizationType.L2),
+    )
+    fe_cfg = FixedEffectCoordinateConfig(
+        feature_shard="global", optimization=opt,
+        regularization_weights=(1.0,),
+    )
+    re_cfg = RandomEffectCoordinateConfig(
+        random_effect_type="userId", feature_shard="per_user",
+        optimization=opt, regularization_weights=(1.0,),
+    )
+    return data, fe_cfg, re_cfg
+
+
+def test_fe_train_and_score_take_batch_as_argument():
+    data, fe_cfg, _ = _game_fixture()
+    coord = build_coordinate(data, fe_cfg)
+    residual = jnp.zeros((data.num_samples,), jnp.float32)
+    w0 = coord.initial_state()
+    reg = jnp.asarray(1.0, jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda b, r, w, g: coord._train_jit(b, r, w, g)
+    )(coord.batch, residual, w0, reg)
+    _assert_no_large_consts(jaxpr, "FixedEffectCoordinate._train_jit")
+    jaxpr = jax.make_jaxpr(lambda b, s: coord._score_jit(b, s))(
+        coord.batch, w0
+    )
+    _assert_no_large_consts(jaxpr, "FixedEffectCoordinate._score_jit")
+
+
+def test_re_bucket_train_takes_buckets_as_arguments():
+    from photon_tpu.game.data import build_random_effect_dataset
+
+    data, _, re_cfg = _game_fixture()
+    ds = build_random_effect_dataset(data, re_cfg)
+    coord = build_coordinate(data, re_cfg, re_dataset=ds)
+    residual = jnp.zeros((data.num_samples,), jnp.float32)
+    state = coord.initial_state()
+    db = coord.device_buckets[0]
+    reg = jnp.asarray(1.0, jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda f, l, o, tw, r, sp, w0, g: coord._train_bucket(
+            f, l, o, tw, r, sp, w0, g
+        )
+    )(
+        db.features, db.labels, db.offsets, db.train_weights,
+        residual, db.sample_pos, state[0], reg,
+    )
+    _assert_no_large_consts(jaxpr, "RandomEffectCoordinate._train_bucket")
+
+
+def test_segmented_owlqn_programs_take_data_as_argument():
+    from photon_tpu.ops.losses import PoissonLoss
+    from photon_tpu.ops.objective import GLMObjective
+    from photon_tpu.optimize.owlqn import SegmentedOWLQN
+    from photon_tpu.types import SparseBatch
+
+    rng = np.random.default_rng(1)
+    n, d, k = 256, 512, 8
+    batch = SparseBatch(
+        indices=jnp.asarray(rng.integers(0, d, size=(n, k)), jnp.int32),
+        values=jnp.asarray(rng.normal(size=(n, k)), jnp.float32),
+        labels=jnp.asarray(rng.poisson(1.0, size=n), jnp.float32),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+        windows=None,
+    )
+    obj = GLMObjective(loss=PoissonLoss, l2_weight=0.1, l1_weight=0.01)
+    solver = SegmentedOWLQN(
+        None, 0.01, OptimizerConfig(max_iterations=4),
+        oracle_factory=obj.smooth_margin_oracle, segment_iters=2,
+    )
+    x0 = jnp.zeros((d,), jnp.float32)
+    jaxpr = jax.make_jaxpr(lambda x, b: solver._init_f(x, b))(x0, batch)
+    _assert_no_large_consts(jaxpr, "SegmentedOWLQN.init")
+    s = solver._init_f(x0, batch)
+    jaxpr = jax.make_jaxpr(lambda ss, b: solver._segment_f(ss, b))(s, batch)
+    _assert_no_large_consts(jaxpr, "SegmentedOWLQN.segment")
