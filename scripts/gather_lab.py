@@ -37,6 +37,11 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    import jax as _jax  # sitecustomize force-selects the axon relay
+
+    _jax.config.update("jax_platforms", "cpu")
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -172,6 +177,37 @@ def main():
 
         scan_timed(gc_step, jnp.zeros((8,), jnp.float32), (tbl2d, idx),
                    "gc chunked row gather+onehot", S, S * 512)
+
+    if want("gcb"):
+        # chunked with a bf16 table: halves the 512 B/slot row traffic IF
+        # the chunked gather is byte-bound; no gain if it is row-op-bound
+        # at ~362M rows/s. Decides whether a production
+        # PHOTON_SPARSE_GATHER=chunked_bf16 opt-in is worth its precision
+        # tax (bf16 has an 8-bit mantissa).
+        tbl_b = tbl.astype(jnp.bfloat16).reshape(-1, 128)
+        idx = jax.device_put(jnp.asarray(mk_idx("random")))
+        seg = 16
+        seg_len = S // seg
+
+        def gcb_step(x, t2_, i_):
+            t2x = t2_ + x[0].astype(jnp.bfloat16)
+
+            def body(s, acc):
+                iseg = jax.lax.dynamic_slice(i_, (s * seg_len,), (seg_len,))
+                rows = t2x[iseg >> 7]
+                onehot = (
+                    (iseg & 127)[:, None]
+                    == jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+                )
+                return acc + jnp.sum(
+                    jnp.where(onehot, rows, 0).astype(jnp.float32)
+                )
+
+            tot = jax.lax.fori_loop(0, seg, body, jnp.float32(0))
+            return x.at[0].add(tot * jnp.float32(1e-12))
+
+        scan_timed(gcb_step, jnp.zeros((8,), jnp.float32), (tbl_b, idx),
+                   "gcb chunked bf16 rows", S, S * 256)
 
     if want("gl"):
         # within-row lane shuffle: [M,128] rows each permuted by their own
