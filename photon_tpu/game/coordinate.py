@@ -212,25 +212,57 @@ class FixedEffectCoordinate(Coordinate):
     def initial_state(self) -> Array:
         return jnp.zeros((self.num_features,), dtype=self.dtype)
 
+    def _norm_args(self) -> tuple:
+        """Normalization factors/shifts as TRACED jit arguments. Reading
+        them through static self would lower the length-D device arrays as
+        HLO literal constants (~4-8 MB per program at d=2²⁰) — the same
+        constant-embedding class the batch-as-argument rule exists for."""
+        return (self.normalization.factors, self.normalization.shifts)
+
+    def _norm_ctx(self, norm_args) -> NormalizationContext:
+        """NormalizationContext over the traced arrays (pytree structure —
+        which of factors/shifts is None — stays static, so jit control flow
+        is unchanged). Single reconstruction point for train AND score: the
+        two paths must never drift back onto static self arrays."""
+        factors, shifts = norm_args
+        if factors is None and shifts is None:
+            return self.normalization
+        return dataclasses.replace(
+            self.normalization, factors=factors, shifts=shifts
+        )
+
+    def _traced_problem(self, norm_args) -> GLMProblem:
+        ctx = self._norm_ctx(norm_args)
+        if ctx is self.normalization:
+            return self.problem
+        return dataclasses.replace(
+            self.problem,
+            objective=dataclasses.replace(
+                self.problem.objective, normalization=ctx
+            ),
+        )
+
     @partial(jax.jit, static_argnums=0)
     def _train_jit(
-        self, batch, residual_scores: Array, w0: Array, reg_weight: Array
+        self, batch, norm_args, residual_scores: Array, w0: Array,
+        reg_weight: Array,
     ):
         # NOTE: only structural attrs of (static) self may be read here —
         # anything λ-dependent must arrive as a traced argument, or a later
         # in-place reweight would silently reuse the stale traced value.
-        # The batch rides as an ARGUMENT, never through static self: a
-        # trace-time constant lowers as HLO literals, and shipping a
-        # multi-hundred-MB module body to the remote compile service is
-        # rejected outright (HTTP 413 at CTR scale) or hangs it for
-        # minutes (PERF.md r4).
+        # The batch AND the normalization arrays ride as ARGUMENTS, never
+        # through static self: a trace-time constant lowers as HLO
+        # literals, and shipping a multi-hundred-MB module body to the
+        # remote compile service is rejected outright (HTTP 413 at CTR
+        # scale) or hangs it for minutes (PERF.md r4).
         b = batch._replace(offsets=batch.offsets + residual_scores)
-        res = self.problem.solve(b, w0, reg_weight)
+        res = self._traced_problem(norm_args).solve(b, w0, reg_weight)
         return res
 
     def train(self, residual_scores: Array, state: Array):
         res = self._train_jit(
             self.batch,
+            self._norm_args(),
             residual_scores,
             state,
             jnp.asarray(self.problem.config.regularization_weight, self.dtype),
@@ -238,17 +270,18 @@ class FixedEffectCoordinate(Coordinate):
         return res.x, res
 
     @partial(jax.jit, static_argnums=0)
-    def _score_jit(self, batch, state: Array) -> Array:
-        eff = self.normalization.effective_coefficients(state)
+    def _score_jit(self, batch, norm_args, state: Array) -> Array:
+        ctx = self._norm_ctx(norm_args)
+        eff = ctx.effective_coefficients(state)
         s = matvec(batch, eff)
-        if self.normalization.shifts is not None:
-            s = s + self.normalization.margin_shift(state)
+        if ctx.shifts is not None:
+            s = s + ctx.margin_shift(state)
         return s
 
     def score(self, state: Array) -> Array:
         """x·(w .* factor) + margin shift — the coordinate's contribution,
         exclusive of data offsets (FixedEffectCoordinate.score:158-166)."""
-        return self._score_jit(self.batch, state)
+        return self._score_jit(self.batch, self._norm_args(), state)
 
     def to_model(self, state: Array) -> FixedEffectModel:
         w = self.normalization.model_to_original_space(state)

@@ -17,8 +17,9 @@ gathered elements, v5e):
 [rows, 128] lanes, fetch WHOLE 128-lane rows by block index (vector
 loads at HBM bandwidth), and select each element's lane with a one-hot
 multiply-reduce (exact: one 0/1 product per lane, so the result is
-bit-identical to ``table[idx]``). The 512 B/element row traffic is the
-price; at ~185 GB/s it beats the 110M elem/s serialized gather 3.2x.
+bit-identical to ``table[idx]``). The 128·itemsize bytes/element row
+traffic (512 B for f32, 256 B bf16, 1024 B f64) is the price; at
+~185 GB/s it beats the 110M elem/s serialized gather 3.2x.
 
 The [*, 128] row-fetch intermediate is bounded by segmenting the flat
 index stream under ``lax.map`` (sequential over segments, each segment
@@ -48,12 +49,14 @@ _ENV = "PHOTON_SPARSE_GATHER"
 _SEG_BYTES = 1 << 30
 
 
-def _num_segments(n_slots: int) -> int:
+def _num_segments(n_slots: int, itemsize: int = 4) -> int:
     """Segment count that keeps each segment's row fetch under
     ``_SEG_BYTES`` (the index stream is padded up to a multiple, so no
     divisibility requirement — an odd slot count must not silently
-    disable segmentation and materialize the full [slots, 128] fetch)."""
-    return max(1, -(-(n_slots * 512) // _SEG_BYTES))
+    disable segmentation and materialize the full [slots, 128] fetch).
+    Per-slot bytes = 128 lanes × the TABLE dtype's itemsize — a float64
+    table doubles the fetch past a 4-byte budget, bf16 halves it."""
+    return max(1, -(-(n_slots * 128 * itemsize) // _SEG_BYTES))
 
 
 def chunked_take(table: Array, idx: Array) -> Array:
@@ -75,7 +78,7 @@ def chunked_take(table: Array, idx: Array) -> Array:
     t2 = padded.reshape(n_rows, 128)
     flat = idx.reshape(-1)
     n = flat.size
-    segs = _num_segments(n)
+    segs = _num_segments(n, jnp.dtype(table.dtype).itemsize)
     lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
 
     def seg_take(iseg):
@@ -102,10 +105,28 @@ def chunked_take(table: Array, idx: Array) -> Array:
 
 
 def take_1d(table: Array, idx: Array) -> Array:
-    """Strategy-dispatched 1-D gather (see module docstring)."""
+    """Strategy-dispatched 1-D gather (see module docstring).
+
+    The ``PHOTON_SPARSE_GATHER`` knob and the AUTO platform choice are
+    resolved at TRACE time: already-compiled programs keep the strategy
+    they were traced with after an env change (set the env before the
+    first call, or bust the jit cache to re-route). AUTO prefers the
+    platform of the device the TABLE actually lives on (eager calls);
+    under a jit trace the operand carries no committed device, so the
+    default backend — which is what the program will compile for — is
+    the right key."""
     impl = os.environ.get(_ENV, "auto").strip().lower()
     if impl == "auto":
-        impl = "chunked" if jax.default_backend() == "tpu" else "plain"
+        platform = None
+        try:
+            devices = table.devices()
+            if devices:
+                platform = next(iter(devices)).platform
+        except Exception:
+            platform = None  # tracer or uncommitted: fall back
+        if platform is None:
+            platform = jax.default_backend()
+        impl = "chunked" if platform == "tpu" else "plain"
     if impl == "chunked":
         return chunked_take(table, idx)
     return table[idx]

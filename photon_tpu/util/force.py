@@ -44,8 +44,16 @@ def force(tree: Any) -> None:
     if len(leaves) == 1:
         np.asarray(leaves[0].reshape(-1)[0:1])
         return
-    np.asarray(
-        jnp.concatenate(
-            [leaf.reshape(-1)[0:1].astype(jnp.float32) for leaf in leaves]
+    try:
+        np.asarray(
+            jnp.concatenate(
+                [leaf.reshape(-1)[0:1].astype(jnp.float32) for leaf in leaves]
+            )
         )
-    )
+    except Exception:
+        # Leaves committed to different devices/platforms (mixed CPU/TPU
+        # trees) or exotic dtypes can make the cross-device concatenate
+        # raise — the barrier must still hold, so fall back to one fetch
+        # per leaf (a round trip each, but correct).
+        for leaf in leaves:
+            np.asarray(leaf.reshape(-1)[0:1])

@@ -63,6 +63,20 @@ def test_num_segments_bounds_fetch_for_any_slot_count():
         assert per_seg <= (1 << 30) + 512 * segs
 
 
+def test_num_segments_scales_with_table_itemsize():
+    # per-slot fetch is 128 lanes x itemsize: a float64 table doubles the
+    # row traffic past a 4-byte budget (must segment ~2x more), bf16
+    # halves it (must not over-segment). ADVICE r4.
+    for n in [56 << 20, (1 << 23) * 7, 1_000_001 * 31]:
+        for itemsize in (2, 4, 8):
+            segs = _num_segments(n, itemsize)
+            per_seg_bytes = -(-n // segs) * 128 * itemsize
+            assert per_seg_bytes <= (1 << 30) + 128 * itemsize * segs
+        # monotone in itemsize and within rounding of proportional
+        assert _num_segments(n, 8) >= _num_segments(n, 4) >= _num_segments(n, 2)
+        assert _num_segments(n, 8) <= 2 * _num_segments(n, 4) + 1
+
+
 def test_chunked_take_odd_slot_count_segments():
     rng = np.random.default_rng(5)
     t = jnp.asarray(rng.standard_normal(777).astype(np.float32))

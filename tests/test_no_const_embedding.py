@@ -116,20 +116,51 @@ def _game_fixture(n=512, fe_dim=64, users=32, d_re=8, seed=0):
     return data, fe_cfg, re_cfg
 
 
+def _assert_fe_coordinate_clean(coord, num_samples, label):
+    residual = jnp.zeros((num_samples,), jnp.float32)
+    w0 = coord.initial_state()
+    reg = jnp.asarray(1.0, jnp.float32)
+    norm = coord._norm_args()
+    jaxpr = jax.make_jaxpr(
+        lambda b, nrm, r, w, g: coord._train_jit(b, nrm, r, w, g)
+    )(coord.batch, norm, residual, w0, reg)
+    _assert_no_large_consts(jaxpr, f"{label}._train_jit")
+    jaxpr = jax.make_jaxpr(lambda b, nrm, s: coord._score_jit(b, nrm, s))(
+        coord.batch, norm, w0
+    )
+    _assert_no_large_consts(jaxpr, f"{label}._score_jit")
+
+
 def test_fe_train_and_score_take_batch_as_argument():
     data, fe_cfg, _ = _game_fixture()
     coord = build_coordinate(data, fe_cfg)
-    residual = jnp.zeros((data.num_samples,), jnp.float32)
-    w0 = coord.initial_state()
-    reg = jnp.asarray(1.0, jnp.float32)
-    jaxpr = jax.make_jaxpr(
-        lambda b, r, w, g: coord._train_jit(b, r, w, g)
-    )(coord.batch, residual, w0, reg)
-    _assert_no_large_consts(jaxpr, "FixedEffectCoordinate._train_jit")
-    jaxpr = jax.make_jaxpr(lambda b, s: coord._score_jit(b, s))(
-        coord.batch, w0
+    _assert_fe_coordinate_clean(
+        coord, data.num_samples, "FixedEffectCoordinate"
     )
-    _assert_no_large_consts(jaxpr, "FixedEffectCoordinate._score_jit")
+
+
+def test_fe_normalization_arrays_are_arguments_not_constants():
+    """Non-identity NormalizationContext: factors/shifts are length-D
+    device arrays — read through static self they lower as HLO literal
+    constants (ADVICE r4 medium). They must ride as traced arguments,
+    same contract as the batch. The fixture dim is sized so the
+    factors/shifts arrays alone exceed the const-bytes limit."""
+    from photon_tpu.ops.normalization import NormalizationContext
+    from photon_tpu.types import NormalizationType
+
+    fe_dim = 8192  # 32 KB f32 factors > _CONST_BYTES_LIMIT
+    data, fe_cfg, _ = _game_fixture(n=64, fe_dim=fe_dim)
+    rng = np.random.default_rng(3)
+    norm = NormalizationContext.build(
+        NormalizationType.STANDARDIZATION,
+        mean=rng.normal(size=fe_dim),
+        variance=rng.uniform(0.5, 2.0, size=fe_dim),
+        intercept_index=0,
+    )
+    coord = build_coordinate(data, fe_cfg, normalization=norm)
+    _assert_fe_coordinate_clean(
+        coord, data.num_samples, "FixedEffectCoordinate[standardized]"
+    )
 
 
 def test_re_bucket_train_takes_buckets_as_arguments():
