@@ -970,7 +970,11 @@ def _run_game_config(
             b.features.shape[0] * b.features.shape[2] for b in ds.buckets
         )
         dev_bytes = sum(
-            b.features.size * 4 + 3 * b.labels.size * 4 + b.labels.size * 4
+            b.features.size * 4
+            + 3 * b.labels.size * 4
+            + b.labels.size * 4
+            + b.score_feats.size * 4
+            + 2 * b.score_pos.size * 4
             for b in ds.buckets
         )
         re_state[name] = {

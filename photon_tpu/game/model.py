@@ -69,13 +69,14 @@ class RandomEffectModel:
     projection_matrix: np.ndarray | None = None
 
     def score(self, data: GameData, dataset: RandomEffectDataset) -> np.ndarray:
-        """Scores aligned to sample position, via the dataset's buckets."""
-        n = data.num_samples
-        scores = np.zeros(n + 1)  # +1 slot swallows padding scatter
+        """Scores aligned to sample position, via the dataset's flat
+        score arrays (active + passive rows, padding-free)."""
+        scores = np.zeros(data.num_samples)
         for bucket, coefs in zip(dataset.buckets, self.buckets):
-            s = np.einsum("end,ed->en", bucket.features, coefs.coefficients)
-            np.add.at(scores, bucket.sample_pos.ravel(), s.ravel())
-        return scores[:n]
+            c = np.asarray(coefs.coefficients)[bucket.score_slot]
+            s = np.einsum("md,md->m", bucket.score_feats, c)
+            np.add.at(scores, bucket.score_pos, s)
+        return scores
 
     def _entity_coefficient_csr(self):
         """[num_entities(+1 zero row), d] sparse coefficient matrix, cached.
