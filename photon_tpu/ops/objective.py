@@ -56,8 +56,20 @@ def matvec(batch, v: Array) -> Array:
         from photon_tpu.ops.gather import take_1d
 
         # take_1d: XLA:TPU's element gather serializes at ~110M elem/s;
-        # the chunked row-fetch form is bandwidth-bound (ops/gather.py)
-        return jnp.sum(take_1d(v, batch.indices) * batch.values, axis=-1)
+        # the chunked row-fetch form is bandwidth-bound (ops/gather.py).
+        # PHOTON_SPARSE_BF16_TABLE=1 stores the gathered coefficient
+        # table bf16: the row fetch is the dominant HBM stream (128·
+        # itemsize B per useful element), so halving the table halves
+        # the fetched bytes; products accumulate in f32. Opt-in until
+        # the on-chip A/B lands (trace-time binding, like the gather
+        # strategy knob).
+        if os.environ.get("PHOTON_SPARSE_BF16_TABLE", "0") == "1":
+            tv = take_1d(v.astype(jnp.bfloat16), batch.indices).astype(
+                jnp.float32
+            )
+        else:
+            tv = take_1d(v, batch.indices)
+        return jnp.sum(tv * batch.values, axis=-1)
     x = batch.features
     if x.dtype == jnp.bfloat16:
         return jax.lax.dot_general(

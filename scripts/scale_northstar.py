@@ -1,29 +1,35 @@
-"""Scale north star: train a ≥10⁸-coefficient sharded random-effect table.
+"""Scale north star: train a ≥10⁹-coefficient sharded random-effect table.
 
-VERDICT r3 missing #1 / next-round #2: the reference claims "hundreds of
-billions of coefficients within Spark" (/root/reference/README.md:80) via
-per-entity sharding (photon-api data/RandomEffectDataSet.scala:47-56) and
-the load-balanced partitioner (RandomEffectDataSetPartitioner.scala:113-147);
-our largest trained RE table before this script was ~1.05M coefficients.
+VERDICT r4 next-round #2 (raising r3's 10⁸ target 10×): the reference
+claims "hundreds of billions of coefficients within Spark"
+(/root/reference/README.md:80) via per-entity sharding (photon-api
+data/RandomEffectDataSet.scala:47-56) and the load-balanced partitioner
+(RandomEffectDataSetPartitioner.scala:113-147); BASELINE config 5 models
+~10⁹ coefficients on a 64-executor cluster.
 
 This script TRAINS (not just builds) a random-effect coordinate with
-  E = 6,250,013 entities × d = 16  →  100,000,208 coefficients
+  E = 62,500,013 entities × d = 16  →  1,000,000,208 coefficients
 on an 8-virtual-device (1 data × 8 entity) CPU mesh — the same
 entity-sharded GSPMD path production uses on real chips — and records:
 
-  * a memory ledger: per-device bytes for the bucketed feature blocks and
-    the coefficient table, checked against a single v5e chip's 16 GiB HBM
-    (the mesh axis divides the entity axis, so per-device = total/8);
-  * sharded == unsharded numerics on a subsample: 256 entities re-trained
+  * a memory ledger: per-device bytes for the bucketed feature blocks,
+    flat score arrays and the coefficient table, checked against a v5e
+    chip's 16 GiB HBM (the mesh axis divides the entity axis, so
+    per-device = total/8);
+  * sharded == unsharded numerics on a subsample: entities re-trained
     unsharded from their own rows must match the sharded table's
     coefficients (per-entity solves are independent given the residual,
     so equality is exact up to f32 reduction order);
-  * wall-clock for build/placement/train/score at this scale.
+  * wall-clock for datagen/build/placement/train/score at this scale.
+    The 10⁹ host build rides the dense fast path in
+    build_random_effect_dataset (skips the per-nonzero pair machinery —
+    ~45 GB of int64 arrays and a 10⁹-key sort at this scale) and must
+    land under 15 minutes (VERDICT r4 done-criterion).
 
-Output: SCALE_NORTHSTAR_r04.json at the repo root (checked in).
+Output: SCALE_NORTHSTAR_r05.json at the repo root (checked in).
 
-Run (about 30-40 min on a 1-core CPU host; the compute is one vmapped
-L-BFGS over 6.25M lanes):
+Run (single-core CPU host; the compute is one vmapped L-BFGS over 62.5M
+lanes — budget ~2 h):
     python scripts/scale_northstar.py [--entities N] [--dim D]
 """
 import argparse
@@ -74,7 +80,12 @@ def re_config(max_iter: int) -> RandomEffectCoordinateConfig:
                 regularization_type=RegularizationType.L2
             ),
             optimizer_config=OptimizerConfig(
-                max_iterations=max_iter, ls_max_iterations=4
+                max_iterations=max_iter,
+                ls_max_iterations=4,
+                # identical numerics for <= 2 iterations (round-robin pair
+                # store), but the vmapped history drops from [E, 10, d] to
+                # [E, 2, d] — at 62.5M lanes that is 80 GB -> 16 GB
+                num_corrections=2,
             ),
         ),
         regularization_weights=(1.0,),
@@ -86,7 +97,7 @@ def build_data(num_entities: int, d_re: int, seed: int) -> GameData:
     rng = np.random.default_rng(seed)
     # every entity appears at least once; a Zipf head carries the skew the
     # reference's greedy bin-packing partitioner exists for
-    extra = num_entities // 4
+    extra = num_entities // 8
     n = num_entities + extra
     uid = np.concatenate(
         [
@@ -95,28 +106,37 @@ def build_data(num_entities: int, d_re: int, seed: int) -> GameData:
         ]
     )
     x = rng.normal(size=(n, d_re)).astype(np.float32)
-    w_true = rng.normal(size=d_re)
-    z = x @ w_true + rng.normal(scale=0.5, size=n)
+    w_true = rng.normal(size=d_re).astype(np.float32)
+    z = x @ w_true + rng.normal(scale=0.5, size=n).astype(np.float32)
     y = (z > 0).astype(np.float64)
+    # direct full-row CSR (f32 values SHARING x's memory): from_dense
+    # would copy the 10⁹-element value stream to f64 (+8 GB) and drop
+    # exact zeros, and the dense fast path needs full rows
+    shard = CSRMatrix(
+        indptr=np.arange(n + 1, dtype=np.int64) * d_re,
+        indices=np.tile(np.arange(d_re, dtype=np.int32), n),
+        values=x.reshape(-1),
+        num_cols=d_re,
+    )
     return GameData.build(
         labels=y,
-        feature_shards={"per_user": CSRMatrix.from_dense(x)},
+        feature_shards={"per_user": shard},
         id_tags={"userId": uid},
     )
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--entities", type=int, default=6_250_013)
+    ap.add_argument("--entities", type=int, default=62_500_013)
     ap.add_argument("--dim", type=int, default=16)
     ap.add_argument("--max-iter", type=int, default=2)
     ap.add_argument("--subsample", type=int, default=256)
-    ap.add_argument("--out", default="SCALE_NORTHSTAR_r04.json")
+    ap.add_argument("--out", default="SCALE_NORTHSTAR_r05.json")
     args = ap.parse_args()
 
     entity_shards = 8
     report = {
-        "target": "train a >=1e8-coefficient sharded random-effect table",
+        "target": "train a >=1e9-coefficient sharded random-effect table",
         "entities": args.entities,
         "dim": args.dim,
         "coefficients": args.entities * args.dim,
@@ -158,7 +178,11 @@ def main() -> None:
     assert budget["coefficient_count"] >= args.entities * args.dim, budget[
         "coefficient_count"
     ]
-    report["at_target_scale"] = budget["coefficient_count"] >= 100_000_000
+    report["at_target_scale"] = budget["coefficient_count"] >= 1_000_000_000
+    report["host_build_under_15min"] = report["build_s"] < 900.0
+    # hard criterion like the HBM/parity asserts below — the artifact must
+    # not claim ok while the r4 done-criterion silently failed
+    assert report["host_build_under_15min"], report["build_s"]
     assert per_device < V5E_HBM_BYTES, report["memory_ledger"]
     print(
         f"build {report['build_s']}s: {budget['coefficient_count']:,} coefs, "
@@ -217,7 +241,9 @@ def main() -> None:
     mask = np.isin(keys_arr, sorted(sub_keys))
     sub_rows = np.nonzero(mask)[0]
     shard = data.feature_shards["per_user"]
-    sub_x = shard.to_dense()[sub_rows]
+    # full-row CSR: value stream reshapes to [n, d] — never densify the
+    # whole 10⁹-element shard to f64 just to slice a few hundred rows
+    sub_x = shard.values.reshape(shard.num_rows, shard.num_cols)[sub_rows]
     sub_data = GameData.build(
         labels=np.asarray(data.labels)[sub_rows],
         feature_shards={"per_user": CSRMatrix.from_dense(sub_x)},

@@ -316,6 +316,82 @@ def test_re_active_split_layout_invariants():
     np.testing.assert_allclose(got, expect, atol=1e-5)
 
 
+def test_re_dense_fast_path_matches_generic_build(monkeypatch):
+    """The dense-shard fast path (skips the (entity, column) pair
+    machinery — the 10⁹-scale host-build bottleneck) must produce
+    buckets identical to the generic path: same shapes, same entity
+    assignment, same block/score features up to the f64→f32 cast."""
+    import dataclasses as dc
+
+    rng = np.random.default_rng(23)
+    n, users = 5_000, 300
+    ids = ((rng.zipf(1.3, size=n) - 1) % users)
+    ids[:users] = rng.permutation(users)
+    x = rng.normal(size=(n, D_RE))
+    data = GameData.build(
+        labels=rng.normal(size=n),
+        feature_shards={"per_user": CSRMatrix.from_dense(x)},
+        id_tags={"userId": np.array([f"u{u:04d}" for u in ids])},
+    )
+    cfg = dc.replace(_configs()["per-user"], active_data_upper_bound=6)
+    # pin both sides so an ambient env leak can never make this compare
+    # generic-vs-generic (a tautological pass)
+    monkeypatch.setenv("PHOTON_RE_DENSE_FAST", "1")
+    ds_fast = build_random_effect_dataset(data, cfg, seed=0)
+    monkeypatch.setenv("PHOTON_RE_DENSE_FAST", "0")
+    ds_gen = build_random_effect_dataset(data, cfg, seed=0)
+    assert len(ds_fast.buckets) == len(ds_gen.buckets)
+    for bf, bg in zip(ds_fast.buckets, ds_gen.buckets):
+        np.testing.assert_array_equal(bf.entity_ids, bg.entity_ids)
+        np.testing.assert_array_equal(bf.sample_pos, bg.sample_pos)
+        np.testing.assert_array_equal(bf.score_pos, bg.score_pos)
+        np.testing.assert_array_equal(bf.score_slot, bg.score_slot)
+        np.testing.assert_array_equal(bf.col_index, bg.col_index)
+        np.testing.assert_allclose(bf.features, bg.features, atol=1e-7)
+        np.testing.assert_allclose(
+            bf.score_feats, bg.score_feats, atol=1e-7
+        )
+        np.testing.assert_array_equal(bf.weights, bg.weights)
+        np.testing.assert_array_equal(bf.labels, bg.labels)
+
+
+def test_re_dense_fast_path_rejects_unsorted_full_rows():
+    """A full-row CSR whose per-row indices are NOT ascending 0..d-1 (e.g.
+    a reader appending the intercept last) must fall back to the generic
+    path — values.reshape would silently mis-assign columns."""
+    from photon_tpu.game.data import CSRMatrix as CSR
+
+    rng = np.random.default_rng(31)
+    n, d, users = 400, 4, 40
+    x = rng.normal(size=(n, d))
+    # descending per-row indices: same logical matrix, reversed storage
+    shard = CSR(
+        indptr=np.arange(n + 1, dtype=np.int64) * d,
+        indices=np.tile(np.arange(d - 1, -1, -1, dtype=np.int32), n),
+        values=x[:, ::-1].reshape(-1),
+        num_cols=d,
+    )
+    ids = rng.integers(0, users, size=n)
+    data = GameData.build(
+        labels=rng.normal(size=n),
+        feature_shards={"per_user": shard},
+        id_tags={"userId": np.array([f"u{u:02d}" for u in ids])},
+    )
+    ds = build_random_effect_dataset(data, _configs()["per-user"])
+    # reconstruct each sample's feature row from the flat score arrays
+    # through col_index — it must equal the logical dense row
+    for b in ds.buckets:
+        for r in range(len(b.score_pos)):
+            got = np.zeros(d)
+            cols = b.col_index[b.score_slot[r]]
+            valid = cols >= 0
+            got[cols[valid]] = b.score_feats[r][valid]
+            np.testing.assert_allclose(
+                got, x[b.score_pos[r]], atol=1e-6,
+                err_msg="unsorted full-row CSR mis-assigned columns",
+            )
+
+
 def test_passive_data_lower_bound_drops_scoring_rows():
     """Entities whose passive-row count is below the bound keep only their
     active rows (reference passiveDataLowerBound)."""
