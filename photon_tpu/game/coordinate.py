@@ -321,6 +321,10 @@ class RandomEffectCoordinate(Coordinate):
     problem_config: GLMProblemConfig
     num_samples: int
     dtype: object
+    #: set when the coordinate's blocks are entity-sharded over a mesh —
+    #: training then runs as shard_map with per-shard independent
+    #: while-loops (zero collectives; see _train_bucket)
+    mesh: object = None
 
     @staticmethod
     def build(
@@ -445,6 +449,7 @@ class RandomEffectCoordinate(Coordinate):
             ),
             num_samples=dataset.num_samples,
             dtype=dtype,
+            mesh=mesh,
         )
 
     def with_regularization_weight(self, w: float) -> "RandomEffectCoordinate":
@@ -474,19 +479,62 @@ class RandomEffectCoordinate(Coordinate):
         reg_weight: Array,
     ):
         """One vmapped solve over all entities of one size bucket. λ arrives
-        traced so the whole λ grid reuses this bucket's compiled program."""
+        traced so the whole λ grid reuses this bucket's compiled program.
+
+        Under a mesh the solve runs as ``shard_map`` over the entity axis
+        with PER-SHARD INDEPENDENT while-loops: per-entity solves share
+        nothing, so the plain GSPMD lowering's only collective — the
+        vmapped while-loop's cross-device ``any(continue)`` reduce, one
+        all-reduce per optimizer iteration — is pure overhead. On real
+        chips that is an ICI sync per iteration for no information; on
+        the virtual CPU mesh it is fatal (XLA:CPU's in-process rendezvous
+        hard-aborts at 40 s when 8 device threads time-slice one core —
+        observed at the 10⁹-coefficient north star). Per-lane numerics
+        are loop-length independent (the while-loop batching rule freezes
+        converged lanes), asserted by the sharded==unsharded parity
+        tests.
+        """
         problem = GLMProblem.build(self.problem_config)
         res_pad = jnp.concatenate([residual, jnp.zeros((1,), residual.dtype)])
-        extra = res_pad[jnp.minimum(sample_pos, residual.shape[0])]
+        n_res = residual.shape[0]
 
-        def solve_one(f, l, o, w, w0_e):
-            batch = LabeledBatch(features=f, labels=l, offsets=o, weights=w)
-            return problem.solve(batch, w0_e, reg_weight)
+        def local_solve(features, labels, offsets, train_weights,
+                        sample_pos, w0, res_pad, reg_weight):
+            extra = res_pad[jnp.minimum(sample_pos, n_res)]
 
-        res = jax.vmap(solve_one)(
-            features, labels, offsets + extra, train_weights, w0
-        )
-        return res
+            def solve_one(f, l, o, w, w0_e):
+                batch = LabeledBatch(
+                    features=f, labels=l, offsets=o, weights=w
+                )
+                return problem.solve(batch, w0_e, reg_weight)
+
+            return jax.vmap(solve_one)(
+                features, labels, offsets + extra, train_weights, w0
+            )
+
+        if self.mesh is None:
+            return local_solve(
+                features, labels, offsets, train_weights, sample_pos, w0,
+                res_pad, reg_weight,
+            )
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from photon_tpu.parallel.mesh import ENTITY_AXIS
+
+        ent = P(ENTITY_AXIS)  # leading axis entity-sharded, rest replicated
+        rep = P()  # residual + λ are replicated on every shard
+        return shard_map(
+            local_solve,
+            mesh=self.mesh,
+            in_specs=(ent, ent, ent, ent, ent, ent, rep, rep),
+            out_specs=ent,  # every OptimizeResult leaf is per-lane [E, ...]
+            # the optimizer's scan/while carries mix shard-varying state
+            # with constant-initialized history buffers — the VMA checker
+            # rejects that mix even though the computation is per-lane
+            check_vma=False,
+        )(features, labels, offsets, train_weights, sample_pos, w0,
+          res_pad, reg_weight)
 
     def train(self, residual_scores: Array, state: list[Array]):
         new_state = []

@@ -844,10 +844,31 @@ def build_random_effect_dataset(
     inv_order = np.argsort(shape_inv, kind="stable")
     shape_counts = np.bincount(shape_inv, minlength=len(shape_keys))
     shape_bounds = np.concatenate(([0], np.cumsum(shape_counts)))
-    bucket_map: dict[tuple[int, int], np.ndarray] = {}
+    # Cap entities per bucket: one bucket = one vmapped solve program, and
+    # an unbounded entity axis makes that program's inter-collective
+    # interval (the while-loop's cross-device convergence reduce) and its
+    # single-dispatch execution size unbounded too. At 10⁹-coefficient
+    # scale a ~50M-entity singleton bucket blew XLA:CPU's hardcoded 40 s
+    # all-reduce rendezvous abort on the virtual mesh, and monolithic
+    # programs of that size are what hit the relay's per-program
+    # execution limit on TPU (PERF.md r4). Same-shape chunks share one
+    # compiled program (jit keys on shapes).
+    cap_env = os.environ.get("PHOTON_RE_MAX_BUCKET_ENTITIES", "").strip()
+    ent_cap = int(cap_env) if cap_env else 8_000_000
+    if ent_cap < 1:
+        raise ValueError(
+            f"PHOTON_RE_MAX_BUCKET_ENTITIES must be >= 1, got {ent_cap}"
+        )
+    # bucket_specs is shape-major by construction: np.unique returns
+    # ascending packed (n<<32|d) keys, which orders like (n, d) tuples
+    bucket_specs: list[tuple[int, int, np.ndarray]] = []
     for bi, key in enumerate(shape_keys):
         ents = ent_list[inv_order[shape_bounds[bi] : shape_bounds[bi + 1]]]
-        bucket_map[(int(key >> 32), int(key & 0xFFFFFFFF))] = ents
+        shape = (int(key >> 32), int(key & 0xFFFFFFFF))
+        for s0 in range(0, len(ents), ent_cap):
+            bucket_specs.append(
+                (shape[0], shape[1], ents[s0 : s0 + ent_cap])
+            )
 
     # per-entity slot assignment within its bucket (shard-major balanced
     # when an entity mesh axis exists; load = active rows, the per-sweep
@@ -855,15 +876,14 @@ def build_random_effect_dataset(
     slot_of_entity = np.full(num_v, -1, dtype=np.int64)
     bucket_of_entity = np.full(num_v, -1, dtype=np.int64)
     flat_start_of_entity = np.zeros(num_v, dtype=np.int64)
-    bucket_shapes = sorted(bucket_map.keys())
-    for bi, key in enumerate(bucket_shapes):
-        ents = np.asarray(bucket_map[key], dtype=np.int64)
+    for bi, (n_max, d_max, ents) in enumerate(bucket_specs):
+        ents = np.asarray(ents, dtype=np.int64)
         if entity_shards > 1 and len(ents) > 1:
             perm = _shard_major_entity_order(
                 n_act[ents].astype(np.float64), entity_shards
             )
             ents = ents[perm]
-            bucket_map[key] = ents
+        bucket_specs[bi] = (n_max, d_max, ents)
         slot_of_entity[ents] = np.arange(len(ents))
         bucket_of_entity[ents] = bi
         flat_start_of_entity[ents] = np.concatenate(
@@ -876,9 +896,35 @@ def build_random_effect_dataset(
     # flat score-row index of every kept row (slot-major within bucket)
     flat_row = flat_start_of_entity[kept_ent] + row_rank
 
+    # Rows grouped by bucket ONCE (stable sort + range bounds): a per-
+    # bucket boolean scan over every kept row is O(buckets × rows) — with
+    # the entity cap splitting the 10⁹-coefficient build into ~30 buckets,
+    # that alone re-read 70M-row masks thirty times and pushed the host
+    # build past its budget.
+    order_rb = np.argsort(row_bucket, kind="stable")
+    rb_bounds = np.searchsorted(
+        row_bucket[order_rb], np.arange(len(bucket_specs) + 1)
+    )
+    if not fast_dense:
+        # same one-pass grouping for the per-nonzero and (entity, column)
+        # pair streams — the sparse/projection branches would otherwise
+        # rescan every nonzero per bucket (O(buckets × nnz), the exact
+        # pattern the row grouping above removes)
+        nnz_bucket = row_bucket[nnz_rowpos]
+        order_nz = np.argsort(nnz_bucket, kind="stable")
+        nz_bounds = np.searchsorted(
+            nnz_bucket[order_nz], np.arange(len(bucket_specs) + 1)
+        )
+        if rnd_proj is None:
+            pair_bucket = bucket_of_entity[pair_ent]
+            order_pair = np.argsort(pair_bucket, kind="stable")
+            pair_bounds = np.searchsorted(
+                pair_bucket[order_pair], np.arange(len(bucket_specs) + 1)
+            )
+
     buckets = []
-    for bi, (n_max, d_max) in enumerate(bucket_shapes):
-        ents = np.asarray(bucket_map[(n_max, d_max)], dtype=np.int64)
+    for bi, (n_max, d_max, ents) in enumerate(bucket_specs):
+        ents = np.asarray(ents, dtype=np.int64)
         E = len(ents)
         feats = np.zeros((E, n_max, d_max), dtype=np.float32)
         labels = np.zeros((E, n_max), dtype=np.float32)
@@ -888,18 +934,18 @@ def build_random_effect_dataset(
         col_index = np.full((E, d_max), -1, dtype=np.int32)
         sample_pos = np.full((E, n_max), n, dtype=np.int32)  # n ⇒ OOB pad
 
-        in_b = row_bucket == bi
+        rows_in_b = order_rb[rb_bounds[bi] : rb_bounds[bi + 1]]
         m_b = int(n_k[ents].sum())
         score_feats = np.zeros((m_b, d_max), dtype=np.float32)
         score_slot = np.zeros(m_b, dtype=np.int32)
         score_pos = np.zeros(m_b, dtype=np.int32)
-        fr_b = flat_row[in_b]
-        score_slot[fr_b] = row_slot[in_b]
-        score_pos[fr_b] = kept_rows[in_b]
+        fr_b = flat_row[rows_in_b]
+        score_slot[fr_b] = row_slot[rows_in_b]
+        score_pos[fr_b] = kept_rows[rows_in_b]
 
-        act_b = in_b & act
-        s, r = row_slot[act_b], act_rank[act_b]
-        rows_act = kept_rows[act_b]
+        act_rows = rows_in_b[act[rows_in_b]]
+        s, r = row_slot[act_rows], act_rank[act_rows]
+        rows_act = kept_rows[act_rows]
         labels[s, r] = data.labels[rows_act]
         offsets[s, r] = data.offsets[rows_act]
         weights[s, r] = data.weights[rows_act]
@@ -908,40 +954,39 @@ def build_random_effect_dataset(
 
         if fast_dense:
             d_col = shard.num_cols
-            score_feats[fr_b, :d_col] = x2d[kept_rows[in_b]]
+            score_feats[fr_b, :d_col] = x2d[kept_rows[rows_in_b]]
             col_index[:, :d_col] = np.arange(d_col, dtype=np.int32)
         elif rnd_proj is None:
-            nz_b = in_b[nnz_rowpos]
-            lc = local_of_pair[pair_inv[nz_b]]
+            nz_sel = order_nz[nz_bounds[bi] : nz_bounds[bi + 1]]
+            lc = local_of_pair[pair_inv[nz_sel]]
             ok = lc >= 0  # Pearson-dropped columns vanish
             score_feats[
-                flat_row[nnz_rowpos[nz_b][ok]], lc[ok]
-            ] = nnz_val[nz_b][ok]
+                flat_row[nnz_rowpos[nz_sel][ok]], lc[ok]
+            ] = nnz_val[nz_sel][ok]
             # per-entity global column map
-            ent_pairs = np.flatnonzero(
-                (bucket_of_entity[pair_ent] == bi) & (local_of_pair >= 0)
-            )
+            pb = order_pair[pair_bounds[bi] : pair_bounds[bi + 1]]
+            ent_pairs = pb[local_of_pair[pb] >= 0]
             col_index[
                 slot_of_entity[pair_ent[ent_pairs]],
                 local_of_pair[ent_pairs],
             ] = pair_col[ent_pairs].astype(np.int32)
         else:
-            nz_b = in_b[nnz_rowpos]
+            nz_sel = order_nz[nz_bounds[bi] : nz_bounds[bi + 1]]
             k = rnd_proj.shape[1]
             dense = np.zeros((m_b, k), dtype=np.float64)
             np.add.at(
                 dense,
-                flat_row[nnz_rowpos[nz_b]],
-                nnz_val[nz_b, None] * rnd_proj[nnz_col[nz_b]],
+                flat_row[nnz_rowpos[nz_sel]],
+                nnz_val[nz_sel, None] * rnd_proj[nnz_col[nz_sel]],
             )
             score_feats[:, :k] = dense.astype(np.float32)
 
         # train blocks gather the active rows' flat features (one source
         # of truth for the compaction/projection algebra)
-        feats[s, r, :] = score_feats[flat_row[act_b]]
+        feats[s, r, :] = score_feats[flat_row[act_rows]]
         # rows with sample weight 0 score exactly 0 (the old block path
         # masked them with `where(weights > 0)`)
-        w_b = np.asarray(data.weights)[kept_rows[in_b]]
+        w_b = np.asarray(data.weights)[kept_rows[rows_in_b]]
         zero_rows = fr_b[w_b <= 0]
         if len(zero_rows):
             score_feats[zero_rows] = 0.0

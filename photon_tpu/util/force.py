@@ -44,6 +44,26 @@ def force(tree: Any) -> None:
     if len(leaves) == 1:
         np.asarray(leaves[0].reshape(-1)[0:1])
         return
+
+    def _sharded(leaf) -> bool:
+        try:
+            return len(leaf.devices()) > 1
+        except Exception:
+            return True  # unknown placement: assume sharded, stay safe
+
+    if any(_sharded(leaf) for leaf in leaves):
+        # A barrier must NEVER introduce device collectives: concatenating
+        # slices of multi-device-sharded leaves compiles a cross-device
+        # program whose all-reduce rendezvous starts while the devices'
+        # queues are still drained unevenly — on the single-core virtual
+        # CPU mesh XLA's in-process rendezvous hard-aborts after 40 s of
+        # skew (observed at the 10⁹-coefficient north star). Per-leaf
+        # fetches read from the owning devices directly. The concatenated
+        # single-fetch fast path below is a RELAY optimization (one round
+        # trip), and relay arrays are single-device by construction.
+        for leaf in leaves:
+            np.asarray(leaf.reshape(-1)[0:1])
+        return
     try:
         np.asarray(
             jnp.concatenate(
@@ -52,8 +72,8 @@ def force(tree: Any) -> None:
         )
     except Exception:
         # Leaves committed to different devices/platforms (mixed CPU/TPU
-        # trees) or exotic dtypes can make the cross-device concatenate
-        # raise — the barrier must still hold, so fall back to one fetch
-        # per leaf (a round trip each, but correct).
+        # trees) or exotic dtypes can make the concatenate raise — the
+        # barrier must still hold, so fall back to one fetch per leaf (a
+        # round trip each, but correct).
         for leaf in leaves:
             np.asarray(leaf.reshape(-1)[0:1])

@@ -392,6 +392,39 @@ def test_re_dense_fast_path_rejects_unsorted_full_rows():
             )
 
 
+def test_re_bucket_entity_cap_splits_and_preserves_coverage(monkeypatch):
+    """PHOTON_RE_MAX_BUCKET_ENTITIES splits oversized shape classes into
+    several same-shape buckets (bounds program size + the vmapped solve's
+    cross-device reduce interval) without losing or duplicating any
+    entity or sample."""
+    rng = np.random.default_rng(41)
+    n, users = 3_000, 900
+    ids = ((rng.zipf(1.4, size=n) - 1) % users)
+    ids[:users] = rng.permutation(users)
+    x = rng.normal(size=(n, D_RE))
+    data = GameData.build(
+        labels=rng.normal(size=n),
+        feature_shards={"per_user": CSRMatrix.from_dense(x)},
+        id_tags={"userId": np.array([f"u{u:04d}" for u in ids])},
+    )
+    cfg = _configs()["per-user"]
+    monkeypatch.delenv("PHOTON_RE_MAX_BUCKET_ENTITIES", raising=False)
+    ds_plain = build_random_effect_dataset(data, cfg)
+    monkeypatch.setenv("PHOTON_RE_MAX_BUCKET_ENTITIES", "100")
+    ds_cap = build_random_effect_dataset(data, cfg)
+    assert len(ds_cap.buckets) > len(ds_plain.buckets)
+    assert all(b.num_entities <= 100 for b in ds_cap.buckets)
+    # same entity set, each exactly once
+    all_ents = np.concatenate([b.entity_ids for b in ds_cap.buckets])
+    assert len(np.unique(all_ents)) == len(all_ents) == users
+    # same sample coverage in the flat score arrays
+    pos_cap = np.sort(np.concatenate([b.score_pos for b in ds_cap.buckets]))
+    pos_plain = np.sort(
+        np.concatenate([b.score_pos for b in ds_plain.buckets])
+    )
+    np.testing.assert_array_equal(pos_cap, pos_plain)
+
+
 def test_passive_data_lower_bound_drops_scoring_rows():
     """Entities whose passive-row count is below the bound keep only their
     active rows (reference passiveDataLowerBound)."""
