@@ -182,8 +182,11 @@ CONFIG_PLAN = [
     ("game_ctr_scale", 5400, 2),
 ]
 
-PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_partial.json")
+#: BENCH_PARTIAL_PATH redirects the cumulative artifact — a CPU-pinned
+#: builder run must not race the TPU rerun loop's BENCH_partial.json
+PARTIAL_PATH = os.environ.get("BENCH_PARTIAL_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.json"
+)
 
 
 def launch_config_worker(name: str, timeout_s: float, env=None):
