@@ -50,24 +50,39 @@ def main() -> int:
     if unknown:
         print(f"unknown configs: {unknown}; known: {sorted(TIMEOUTS)}")
         return 2
+    # GLOBAL deadline across all requested configs: a per-config budget
+    # compounds (N configs × budget of probing) and can leave this loop
+    # alive as a second relay client when the driver's own end-of-round
+    # bench run starts — the one-client rule must hold against the
+    # official artifact run above all.
     wait_budget_s = float(os.environ.get("RERUN_WAIT_BUDGET_S", 5400))
+    global_deadline = time.time() + wait_budget_s
     results = json.load(open(_PARTIAL))
     replaced = 0
     for name in names:
-        deadline = time.time() + wait_budget_s
+        timeout_s = TIMEOUTS[name]
+        # the deadline gates WORK, not just waiting: a config whose
+        # worst-case worker run cannot finish by the deadline (+10 min
+        # grace) must not start — a late-started full-scale worker is
+        # itself the second-client overlap this deadline exists to avoid
+        if time.time() + 180 + timeout_s > global_deadline + 600:
+            print(f"[rerun] deadline too close for {name} "
+                  f"(needs {timeout_s}s); keeping stale", flush=True)
+            continue
         up = probe()
-        while not up and time.time() < deadline:
+        while not up and time.time() < global_deadline:
             print(f"[rerun] chip unreachable; retrying probe in 240s "
-                  f"({(deadline - time.time()) / 60:.0f} min left)",
+                  f"({(global_deadline - time.time()) / 60:.0f} min left)",
                   flush=True)
             time.sleep(240)
+            if time.time() + 180 + timeout_s > global_deadline + 600:
+                break
             up = probe()
         if not up:
             print(f"[rerun] chip unreachable; keeping stale {name}",
                   flush=True)
             continue
         t0 = time.perf_counter()
-        timeout_s = TIMEOUTS[name]
         print(f"[rerun] === {name} (timeout {timeout_s}s) ===", flush=True)
         detail, err = launch_config_worker(name, timeout_s)
         if detail is None:
