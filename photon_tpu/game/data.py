@@ -373,6 +373,19 @@ def _ceil_pow2_vec(arr: np.ndarray, floor: int) -> np.ndarray:
     return (1 << np.ceil(np.log2(a)).astype(np.int64)).astype(np.int64)
 
 
+def re_bucket_entity_cap() -> int:
+    """Normalized PHOTON_RE_MAX_BUCKET_ENTITIES (single parse site — the
+    checkpoint fingerprint must hash the SAME value the build uses, or
+    equivalent configs spuriously hard-fail resume as stale)."""
+    cap_env = os.environ.get("PHOTON_RE_MAX_BUCKET_ENTITIES", "").strip()
+    ent_cap = int(cap_env) if cap_env else 8_000_000
+    if ent_cap < 1:
+        raise ValueError(
+            f"PHOTON_RE_MAX_BUCKET_ENTITIES must be >= 1, got {ent_cap}"
+        )
+    return ent_cap
+
+
 def _optimal_row_levels(
     sizes: np.ndarray, waste_target: float = 0.12, max_levels: int = 16
 ) -> np.ndarray:
@@ -853,12 +866,7 @@ def build_random_effect_dataset(
     # programs of that size are what hit the relay's per-program
     # execution limit on TPU (PERF.md r4). Same-shape chunks share one
     # compiled program (jit keys on shapes).
-    cap_env = os.environ.get("PHOTON_RE_MAX_BUCKET_ENTITIES", "").strip()
-    ent_cap = int(cap_env) if cap_env else 8_000_000
-    if ent_cap < 1:
-        raise ValueError(
-            f"PHOTON_RE_MAX_BUCKET_ENTITIES must be >= 1, got {ent_cap}"
-        )
+    ent_cap = re_bucket_entity_cap()
     # bucket_specs is shape-major by construction: np.unique returns
     # ascending packed (n<<32|d) keys, which orders like (n, d) tuples
     bucket_specs: list[tuple[int, int, np.ndarray]] = []

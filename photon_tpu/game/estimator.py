@@ -247,6 +247,8 @@ class GameEstimator:
 
             # stale-config guard: resuming state trained under different
             # hyperparameters must be a hard error, not silent reuse
+            from photon_tpu.game.data import re_bucket_entity_cap
+
             fingerprint = repr(
                 (
                     self.task,
@@ -259,6 +261,12 @@ class GameEstimator:
                     sorted(self.locked_coordinates),
                     self.seed,
                     data.num_samples,
+                    # layout knob: a different bucket-entity cap changes the
+                    # per-bucket state SHAPES — resuming across it must be
+                    # the clean stale-config error, not a cryptic unflatten
+                    # failure. Normalized via the build's own parse site so
+                    # equivalent configs never spuriously invalidate.
+                    re_bucket_entity_cap(),
                 )
             )
             checkpointer = DescentCheckpointer(
