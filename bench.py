@@ -361,12 +361,19 @@ def _timed_run(fn, key):
 
     The wall is closed by fetching the digest scalar (``_digest_wrap``),
     never by block_until_ready — which returns at enqueue over the relay
-    and yields walls that exclude the device execution entirely."""
+    and yields walls that exclude the device execution entirely.
+
+    Returns ``(result, wall, entropy)`` — the folded time_ns value is
+    surfaced so each config's JSON row can record it (``value_entropy``):
+    convergence-dependent metrics (n_evals, wall_to_converge_s) vary with
+    the data draw, and cross-round deltas need to separate that draw noise
+    from real regressions (ADVICE r5 #4)."""
     import contextlib
 
     import jax
 
-    key = jax.random.fold_in(key, time.time_ns() & 0x7FFFFFFF)
+    entropy = time.time_ns() & 0x7FFFFFFF
+    key = jax.random.fold_in(key, entropy)
     k_warm, k_timed = jax.random.split(key)
     forced = _digest_wrap(fn)
     float(forced(k_warm)[1])
@@ -381,7 +388,7 @@ def _timed_run(fn, key):
         out, dig = forced(k_timed)
         float(dig)
         wall = time.perf_counter() - t0
-    return out, wall
+    return out, wall, entropy
 
 
 # ---------------------------------------------------------------------------
@@ -429,7 +436,7 @@ def config_a1a(peak_flops, scale):
             oracle=obj.directional_oracle(batch),  # production default path
         )
 
-    res, wall = _timed_run(run, jax.random.PRNGKey(1))
+    res, wall, entropy = _timed_run(run, jax.random.PRNGKey(1))
     evals = int(res.n_evals)
     # margin-space line search: trials are O(N) elementwise; feature-block
     # passes are the honest FLOP unit (2·N·D flops per pass)
@@ -438,6 +445,7 @@ def config_a1a(peak_flops, scale):
     return {
         "n": n,
         "d": d,
+        "value_entropy": entropy,
         "wall_to_converge_s": round(wall, 4),
         "iterations": int(res.iterations),
         "n_evals": evals,
@@ -529,18 +537,19 @@ def config_tron(peak_flops, scale):
             "achieved_gbps": round(approx_bytes / wall / 1e9, 1),
         }
 
-    res, wall = _timed_run(make_run(dtype), jax.random.PRNGKey(2))
-    out = {"n": n, "d": d, **summarize(res, wall, 4.0)}
+    res, wall, entropy = _timed_run(make_run(dtype), jax.random.PRNGKey(2))
+    out = {"n": n, "d": d, "value_entropy": entropy, **summarize(res, wall, 4.0)}
 
     # bfloat16 feature block (f32 MXU accumulation, f32 optimizer state):
     # halves HBM traffic on the dominant [N, D] reads (VERDICT r2 weak #3).
     # Skipped on the CPU fallback — XLA:CPU emulates bf16 and the number
     # would measure the emulation, not the feature.
     if scale != "cpu":
-        res_b, wall_b = _timed_run(
+        res_b, wall_b, entropy_b = _timed_run(
             make_run(jnp.bfloat16), jax.random.PRNGKey(2)
         )
         out["bf16"] = summarize(res_b, wall_b, 2.0)
+        out["bf16"]["value_entropy"] = entropy_b
         out["bf16"]["final_loss_rel_diff"] = round(
             abs(float(res_b.value) - float(res.value))
             / max(abs(float(res.value)), 1e-12),
@@ -750,9 +759,8 @@ def config_sparse_poisson(peak_flops, scale):
     # For the segmented path the final state depends on every segment
     # program, so forcing the last result bounds the whole chain.
     force(run(batch, jnp.zeros((d,), dtype)))
-    w0_key = jax.random.fold_in(
-        jax.random.PRNGKey(30), time.time_ns() & 0x7FFFFFFF
-    )
+    w0_entropy = time.time_ns() & 0x7FFFFFFF
+    w0_key = jax.random.fold_in(jax.random.PRNGKey(30), w0_entropy)
     w0 = 1e-6 * jax.random.normal(w0_key, (d,), dtype)
     t0 = time.perf_counter()
     res = run(batch, w0)
@@ -780,6 +788,7 @@ def config_sparse_poisson(peak_flops, scale):
         "n": n,
         "d": d,
         "nnz_per_row": k,
+        "value_entropy": w0_entropy,
         "ell_batch_bytes": int(n * k * 8),
         "dense_equivalent_bytes": int(n) * int(d) * 4,
         "host_gen_s": round(gen_s, 1),
@@ -902,8 +911,9 @@ def _run_game_config(
     # cache hits across sessions; VALUES (features, labels) fold in
     # wall-clock entropy so the relay's cross-session (executable, inputs)
     # memoization can never replay a previous round's fit as a ~0 s wall.
+    value_entropy = time.time_ns() & 0xFFFFFFFF
     vrng = np.random.default_rng(
-        np.random.SeedSequence([seed + 1, time.time_ns() & 0xFFFFFFFF])
+        np.random.SeedSequence([seed + 1, value_entropy])
     )
     t0 = time.perf_counter()
 
@@ -1043,12 +1053,37 @@ def _run_game_config(
     grouped_wall = time.perf_counter() - t0
 
     # steady-state sweep time: tracker iterations >= 1 (iteration 0 pays
-    # compiles); falls back to all iterations when only one ran
+    # compiles); falls back to all iterations when only one ran. Under the
+    # default "sweep" tracker granularity the honest (barrier-closed)
+    # walls live in the per-sweep rows; per-coordinate rows carry ENQUEUE
+    # walls only (the sync-free steady state pays one read-back per sweep,
+    # game/descent.py).
     it_rows = [r for r in result.tracker if "coordinate" in r]
+    sweep_rows = [r for r in result.tracker if "sweep_seconds" in r]
     steady = [r for r in it_rows if r["iteration"] >= 1]
     measured = steady if steady else it_rows
-    measured_sweeps = len({r["iteration"] for r in measured})
-    steady_s = sum(r["seconds"] for r in measured)
+    steady_sweeps = [r for r in sweep_rows if r["iteration"] >= 1]
+    measured_sweep_rows = steady_sweeps if steady_sweeps else sweep_rows
+    if measured_sweep_rows:
+        measured_sweeps = len(measured_sweep_rows)
+        steady_s = sum(r["sweep_seconds"] for r in measured_sweep_rows)
+        sweep_barrier_s = sum(
+            r.get("barrier_seconds", 0.0) for r in measured_sweep_rows
+        )
+        dispatches_per_sweep = sum(
+            r["dispatches"] for r in measured_sweep_rows
+        ) / measured_sweeps
+        granularity = measured_sweep_rows[0].get("granularity")
+    else:
+        # defensive guard only: the current descent appends a per-sweep
+        # row under BOTH granularities, so this is unreachable for any
+        # tracker it produces (it would take a zero-iteration run or a
+        # pre-r6 tracker format). Fall back to per-coordinate walls.
+        measured_sweeps = len({r["iteration"] for r in measured})
+        steady_s = sum(r["seconds"] for r in measured)
+        sweep_barrier_s = None
+        dispatches_per_sweep = None
+        granularity = None
     steady_examples = _game_examples_from_tracker(measured, datasets, n)
     total_examples = sum(v["examples"] for v in steady_examples.values())
 
@@ -1056,6 +1091,7 @@ def _run_game_config(
         "n": n,
         "fe_dim": fe_dim,
         "fe_nnz": fe_nnz,
+        "value_entropy": value_entropy,
         "fe_layout": "sparse_ell" if fe_nnz < fe_dim else "dense",
         "coordinates": {
             name: {"num_entities": ne, "d_re": dr, "active_upper_bound": ub}
@@ -1072,11 +1108,20 @@ def _run_game_config(
             "wall_s": round(grouped_wall, 3),
         },
         "steady_sweep_s": round(steady_s, 4),
+        # dispatch/sync profile of the measured window (fused sweep:
+        # 1 program per coordinate per sweep + one read-back barrier)
+        "dispatches_per_sweep": dispatches_per_sweep,
+        "sweep_barrier_s": round(sweep_barrier_s, 4)
+        if sweep_barrier_s is not None
+        else None,
+        "tracker_granularity": granularity,
         "examples_per_sec": round(total_examples / steady_s, 1)
         if steady_s > 0
         else None,
         # measured (steady) window only — the same window
-        # examples_per_sec and the Spark model cover
+        # examples_per_sec and the Spark model cover. Under "sweep"
+        # granularity the per-coordinate seconds are ENQUEUE walls
+        # (relative split only); the honest wall is steady_sweep_s.
         "per_coordinate": {
             cid: {
                 "seconds": round(v["seconds"], 4),

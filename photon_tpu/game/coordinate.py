@@ -18,6 +18,7 @@ TPU design:
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 from functools import partial
 
@@ -46,6 +47,52 @@ from photon_tpu.data.dataset import choose_sparse
 from photon_tpu.ops.objective import matvec
 from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
 from photon_tpu.types import Array, LabeledBatch, SparseBatch
+from photon_tpu.util import dispatch_count
+
+#: Per-program TRACE counters: the Python bodies below bump these, and
+#: Python side effects run only when jit traces — so a steady-state sweep
+#: that retraces shows up as a counter > 1. The fused-sweep dispatch
+#: regression test pins them.
+TRACE_COUNTERS: collections.Counter = collections.Counter()
+
+
+def sweep_donation_enabled() -> bool:
+    """Whether the fused sweep step donates its total/score/state buffers.
+
+    On XLA:CPU (jaxlib 0.4.37) donated fused-sweep buffers intermittently
+    corrupt the allocator heap — reproduced as ``double free or
+    corruption`` / ``corrupted size vs. prev_size`` aborts at teardown
+    and NaN scores mid-run (~1 in 10 runs of tests/test_mf.py + the
+    fused-sweep suite; never without donation). Donation is therefore
+    enabled only off-CPU, where it is the designed steady-state memory
+    win (the [N] temporaries and coefficient blocks at north-star scale).
+    ``PHOTON_SWEEP_DONATION=0/1`` overrides for A/B and triage.
+
+    Called lazily (first sweep step), never at import — reading the
+    default backend initializes it.
+    """
+    import os
+
+    env = os.environ.get("PHOTON_SWEEP_DONATION", "").strip()
+    if env in ("0", "1"):
+        return env == "1"
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def _make_sweep_jits(body, static_argnums, donate_argnums):
+    """The fused sweep step compiles as a (donating, non-donating) pair;
+    ``Coordinate._active_sweep_jit`` picks per backend. One construction
+    site so a future donation quirk (like the XLA:CPU corruption that
+    motivated the split) lands in one place."""
+    return (
+        partial(
+            jax.jit, static_argnums=static_argnums,
+            donate_argnums=donate_argnums,
+        )(body),
+        partial(jax.jit, static_argnums=static_argnums)(body),
+    )
 
 
 def _use_sparse(
@@ -76,6 +123,42 @@ class Coordinate:
     def score(self, state) -> Array:
         raise NotImplementedError
 
+    def sweep_step(self, total: Array, score: Array, state, donate=None):
+        """One coordinate-descent step: residual = total − own score, train
+        on it, rescore, fold the new score back into the total.
+        ``donate`` pins the buffer-donation choice for the whole descent
+        run (descent decides ONCE and threads it through, so the copy
+        discipline and the actual donation can never diverge mid-run);
+        ``None`` falls back to ``sweep_donation_enabled()``.
+
+        → ``(new_state, new_score, new_total, info)``.
+
+        This base implementation is the UNFUSED reference sequence — the
+        same dispatches the descent loop used to issue one by one (kept as
+        the fused-vs-unfused parity oracle and profiling A/B). Subclasses
+        override it with a single jit-compiled program that donates
+        ``total``, ``score``, and ``state``, so the [N] residual/score
+        temporaries and the coefficient block reuse their input buffers
+        instead of being fresh allocations every step.
+        """
+        residual = total - score
+        new_state, info = self.train(residual, state)
+        new_score = self.score(new_state)
+        new_total = residual + new_score
+        dispatch_count.record(2)  # the two eager elementwise [N] updates
+        return new_state, new_score, new_total, info
+
+    #: (donating, non-donating) fused-step pair, set per subclass via
+    #: ``_make_sweep_jits``
+    _sweep_jit = None
+    _sweep_jit_nodonate = None
+
+    @classmethod
+    def _active_sweep_jit(cls, donate=None):
+        if donate is None:
+            donate = sweep_donation_enabled()
+        return cls._sweep_jit if donate else cls._sweep_jit_nodonate
+
     def to_model(self, state):
         raise NotImplementedError
 
@@ -89,6 +172,10 @@ class FixedEffectCoordinate(Coordinate):
     problem: GLMProblem
     dtype: object
     num_features: int
+    #: set when the batch rows are sharded over a device mesh — the fused
+    #: sweep step then pins its [N] residual/total chain to the row
+    #: sharding (parallel/mesh.constrain_rows)
+    mesh: object = None
 
     @staticmethod
     def build(
@@ -194,6 +281,7 @@ class FixedEffectCoordinate(Coordinate):
             problem=problem,
             dtype=dtype,
             num_features=shard.num_cols,
+            mesh=mesh,
         )
 
     def with_regularization_weight(self, w: float) -> "FixedEffectCoordinate":
@@ -255,11 +343,13 @@ class FixedEffectCoordinate(Coordinate):
         # literals, and shipping a multi-hundred-MB module body to the
         # remote compile service is rejected outright (HTTP 413 at CTR
         # scale) or hangs it for minutes (PERF.md r4).
-        b = batch._replace(offsets=batch.offsets + residual_scores)
-        res = self._traced_problem(norm_args).solve(b, w0, reg_weight)
+        res = self._traced_problem(norm_args).solve(
+            batch, w0, reg_weight, extra_offsets=residual_scores
+        )
         return res
 
     def train(self, residual_scores: Array, state: Array):
+        dispatch_count.record(1)
         res = self._train_jit(
             self.batch,
             self._norm_args(),
@@ -269,8 +359,7 @@ class FixedEffectCoordinate(Coordinate):
         )
         return res.x, res
 
-    @partial(jax.jit, static_argnums=0)
-    def _score_jit(self, batch, norm_args, state: Array) -> Array:
+    def _score_body(self, batch, norm_args, state: Array) -> Array:
         ctx = self._norm_ctx(norm_args)
         eff = ctx.effective_coefficients(state)
         s = matvec(batch, eff)
@@ -278,10 +367,52 @@ class FixedEffectCoordinate(Coordinate):
             s = s + ctx.margin_shift(state)
         return s
 
+    @partial(jax.jit, static_argnums=0)
+    def _score_jit(self, batch, norm_args, state: Array) -> Array:
+        return self._score_body(batch, norm_args, state)
+
     def score(self, state: Array) -> Array:
         """x·(w .* factor) + margin shift — the coordinate's contribution,
         exclusive of data offsets (FixedEffectCoordinate.score:158-166)."""
+        dispatch_count.record(1)
         return self._score_jit(self.batch, self._norm_args(), state)
+
+    def _sweep_body(
+        self, batch, norm_args, total, score, state, reg_weight
+    ):
+        """Whole CD step as ONE program: residual = total − score, solve on
+        the residual offsets, rescore, total update. Compiled as
+        ``_sweep_jit`` (total/score/state DONATED — the [N] temporaries
+        and the coefficient block reuse their input buffers every
+        steady-state step; the solve's history buffers remain its own) and
+        ``_sweep_jit_nodonate`` (XLA:CPU — see sweep_donation_enabled)."""
+        TRACE_COUNTERS["fe_sweep"] += 1
+        from photon_tpu.parallel.mesh import constrain_rows
+
+        residual = constrain_rows(total - score, self.mesh)
+        res = self._traced_problem(norm_args).solve(
+            batch, state, reg_weight, extra_offsets=residual
+        )
+        new_score = self._score_body(batch, norm_args, res.x)
+        new_total = constrain_rows(residual + new_score, self.mesh)
+        return res.x, new_score, new_total, res
+
+    _sweep_jit, _sweep_jit_nodonate = _make_sweep_jits(
+        _sweep_body, static_argnums=0, donate_argnums=(3, 4, 5)
+    )
+
+    def sweep_step(self, total: Array, score: Array, state: Array,
+                   donate=None):
+        dispatch_count.record(1)
+        return self._active_sweep_jit(donate)(
+            self,
+            self.batch,
+            self._norm_args(),
+            total,
+            score,
+            state,
+            jnp.asarray(self.problem.config.regularization_weight, self.dtype),
+        )
 
     def to_model(self, state: Array) -> FixedEffectModel:
         w = self.normalization.model_to_original_space(state)
@@ -466,20 +597,22 @@ class RandomEffectCoordinate(Coordinate):
             for b in self.device_buckets
         ]
 
-    @partial(jax.jit, static_argnums=(0,))
-    def _train_bucket(
+    def _solve_bucket(
         self,
         features: Array,
         labels: Array,
         offsets: Array,
         train_weights: Array,
-        residual: Array,
         sample_pos: Array,
         w0: Array,
+        res_pad: Array,
         reg_weight: Array,
     ):
-        """One vmapped solve over all entities of one size bucket. λ arrives
-        traced so the whole λ grid reuses this bucket's compiled program.
+        """One vmapped solve over all entities of one size bucket (traced
+        body shared by the legacy per-bucket jit and the fused multi-bucket
+        programs). ``res_pad`` is the residual with its zero sentinel
+        already appended — the fused programs build it ONCE per sweep
+        instead of once per bucket.
 
         Under a mesh the solve runs as ``shard_map`` over the entity axis
         with PER-SHARD INDEPENDENT while-loops: per-entity solves share
@@ -495,8 +628,7 @@ class RandomEffectCoordinate(Coordinate):
         tests.
         """
         problem = GLMProblem.build(self.problem_config)
-        res_pad = jnp.concatenate([residual, jnp.zeros((1,), residual.dtype)])
-        n_res = residual.shape[0]
+        n_res = res_pad.shape[0] - 1
 
         def local_solve(features, labels, offsets, train_weights,
                         sample_pos, w0, res_pad, reg_weight):
@@ -517,48 +649,78 @@ class RandomEffectCoordinate(Coordinate):
                 features, labels, offsets, train_weights, sample_pos, w0,
                 res_pad, reg_weight,
             )
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        from photon_tpu.parallel.mesh import ENTITY_AXIS
+        from photon_tpu.parallel.mesh import ENTITY_AXIS, shard_map_unchecked
 
         ent = P(ENTITY_AXIS)  # leading axis entity-sharded, rest replicated
         rep = P()  # residual + λ are replicated on every shard
-        return shard_map(
+        # the optimizer's scan/while carries mix shard-varying state with
+        # constant-initialized history buffers — the VMA/replication
+        # checker rejects that mix even though the computation is per-lane;
+        # test_re_train_program_has_no_collectives is the real contract
+        return shard_map_unchecked(
             local_solve,
             mesh=self.mesh,
             in_specs=(ent, ent, ent, ent, ent, ent, rep, rep),
             out_specs=ent,  # every OptimizeResult leaf is per-lane [E, ...]
-            # the optimizer's scan/while carries mix shard-varying state
-            # with constant-initialized history buffers — the VMA checker
-            # rejects that mix even though the computation is per-lane
-            check_vma=False,
         )(features, labels, offsets, train_weights, sample_pos, w0,
           res_pad, reg_weight)
 
+    @partial(jax.jit, static_argnums=(0,))
+    def _train_bucket(
+        self,
+        features: Array,
+        labels: Array,
+        offsets: Array,
+        train_weights: Array,
+        residual: Array,
+        sample_pos: Array,
+        w0: Array,
+        reg_weight: Array,
+    ):
+        """Legacy single-bucket entry (kept for the no-collectives and
+        no-const-embedding contracts and ad-hoc probing); the descent hot
+        path dispatches all buckets as one program (`_train_all_jit` /
+        `_sweep_jit`)."""
+        res_pad = jnp.concatenate([residual, jnp.zeros((1,), residual.dtype)])
+        return self._solve_bucket(
+            features, labels, offsets, train_weights, sample_pos, w0,
+            res_pad, reg_weight,
+        )
+
+    def _train_args(self) -> tuple:
+        return tuple(
+            (db.features, db.labels, db.offsets, db.train_weights,
+             db.sample_pos)
+            for db in self.device_buckets
+        )
+
+    @partial(jax.jit, static_argnums=0)
+    def _train_all_jit(self, bucket_args, residual, state, reg_weight):
+        """All size buckets in ONE compiled program (buckets ride as pytree
+        leaves). The per-bucket vmapped solves are independent, so the
+        fusion is free parallelism for XLA — and one dispatch replaces the
+        former one-jit-call-per-bucket serial chain. λ stays traced: the
+        whole λ grid reuses this single program per coordinate."""
+        TRACE_COUNTERS["re_train_all"] += 1
+        res_pad = jnp.concatenate([residual, jnp.zeros((1,), residual.dtype)])
+        infos = [
+            self._solve_bucket(f, l, o, tw, sp, w0, res_pad, reg_weight)
+            for (f, l, o, tw, sp), w0 in zip(bucket_args, state)
+        ]
+        return [r.x for r in infos], infos
+
     def train(self, residual_scores: Array, state: list[Array]):
-        new_state = []
-        infos = []
+        dispatch_count.record(1)
         reg_w = jnp.asarray(
             self.problem_config.regularization_weight, self.dtype
         )
-        for db, w0 in zip(self.device_buckets, state):
-            res = self._train_bucket(
-                db.features,
-                db.labels,
-                db.offsets,
-                db.train_weights,
-                residual_scores,
-                db.sample_pos,
-                w0,
-                reg_w,
-            )
-            new_state.append(res.x)
-            infos.append(res)
-        return new_state, infos
+        return self._train_all_jit(
+            self._train_args(), residual_scores, state, reg_w
+        )
 
-    @partial(jax.jit, static_argnums=(0, 5))
-    def _score_flat(
+    def _score_bucket_body(
         self, score_feats, score_slot, score_pos, coefs, pad_slots
     ) -> Array:
         """Flat padding-free scoring: one compacted feature row per kept
@@ -580,17 +742,84 @@ class RandomEffectCoordinate(Coordinate):
         out = out.at[score_pos].add(s, unique_indices=True)
         return out[: self.num_samples]
 
-    def score(self, state: list[Array]) -> Array:
+    @partial(jax.jit, static_argnums=(0, 5))
+    def _score_flat(
+        self, score_feats, score_slot, score_pos, coefs, pad_slots
+    ) -> Array:
+        return self._score_bucket_body(
+            score_feats, score_slot, score_pos, coefs, pad_slots
+        )
+
+    def _score_args(self) -> tuple:
+        return tuple(
+            (db.score_feats, db.score_slot, db.score_pos)
+            for db in self.device_buckets
+        )
+
+    def _pad_slots(self) -> tuple:
+        return tuple(db.score_pad_slots for db in self.device_buckets)
+
+    @partial(jax.jit, static_argnums=(0, 3))
+    def _score_all_jit(self, score_args, state, pad_slots) -> Array:
+        TRACE_COUNTERS["re_score_all"] += 1
         total = jnp.zeros((self.num_samples,), dtype=self.dtype)
-        for db, coefs in zip(self.device_buckets, state):
-            total = total + self._score_flat(
-                db.score_feats,
-                db.score_slot,
-                db.score_pos,
-                coefs,
-                db.score_pad_slots,
-            )
+        for (sf, ss, sp), coefs, pad in zip(score_args, state, pad_slots):
+            total = total + self._score_bucket_body(sf, ss, sp, coefs, pad)
         return total
+
+    def score(self, state: list[Array]) -> Array:
+        dispatch_count.record(1)
+        return self._score_all_jit(
+            self._score_args(), state, self._pad_slots()
+        )
+
+    def _sweep_body(
+        self, bucket_args, score_args, total, score, state, pad_slots,
+        reg_weight,
+    ):
+        """Whole CD step for ALL buckets as ONE program: residual, every
+        bucket's vmapped solve, every bucket's scatter-score, total update.
+        Compiled as ``_sweep_jit`` (total/score/state DONATED — the [N]
+        temporaries and each bucket's coefficient block reuse their input
+        buffers) and ``_sweep_jit_nodonate`` (XLA:CPU — see
+        sweep_donation_enabled). The residual's zero-sentinel pad is built
+        once, not per bucket."""
+        TRACE_COUNTERS["re_sweep"] += 1
+        residual = total - score
+        res_pad = jnp.concatenate([residual, jnp.zeros((1,), residual.dtype)])
+        infos = [
+            self._solve_bucket(f, l, o, tw, sp, w0, res_pad, reg_weight)
+            for (f, l, o, tw, sp), w0 in zip(bucket_args, state)
+        ]
+        new_state = [r.x for r in infos]
+        new_score = jnp.zeros((self.num_samples,), dtype=self.dtype)
+        for (sf, ss, sp), coefs, pad in zip(score_args, new_state, pad_slots):
+            new_score = new_score + self._score_bucket_body(
+                sf, ss, sp, coefs, pad
+            )
+        new_total = residual + new_score
+        return new_state, new_score, new_total, infos
+
+    _sweep_jit, _sweep_jit_nodonate = _make_sweep_jits(
+        _sweep_body, static_argnums=(0, 6), donate_argnums=(3, 4, 5)
+    )
+
+    def sweep_step(self, total: Array, score: Array, state: list[Array],
+                   donate=None):
+        dispatch_count.record(1)
+        reg_w = jnp.asarray(
+            self.problem_config.regularization_weight, self.dtype
+        )
+        return self._active_sweep_jit(donate)(
+            self,
+            self._train_args(),
+            self._score_args(),
+            total,
+            score,
+            state,
+            self._pad_slots(),
+            reg_w,
+        )
 
     def to_model(self, state: list[Array]) -> RandomEffectModel:
         buckets = []
@@ -715,8 +944,7 @@ class MatrixFactorizationCoordinate(Coordinate):
             jnp.asarray(v, dtype=self.dtype),
         )
 
-    @partial(jax.jit, static_argnums=0)
-    def _train_jit(
+    def _train_body(
         self,
         data,
         residual_scores: Array,
@@ -762,6 +990,17 @@ class MatrixFactorizationCoordinate(Coordinate):
         u, v = unpack(res.x)
         return u, v, res
 
+    @partial(jax.jit, static_argnums=0)
+    def _train_jit(
+        self,
+        data,
+        residual_scores: Array,
+        u0: Array,
+        v0: Array,
+        l2_weight: Array,
+    ):
+        return self._train_body(data, residual_scores, u0, v0, l2_weight)
+
     def _data_args(self):
         return (
             self.row_idx,
@@ -772,6 +1011,7 @@ class MatrixFactorizationCoordinate(Coordinate):
         )
 
     def train(self, residual_scores: Array, state):
+        dispatch_count.record(1)
         u, v, res = self._train_jit(
             self._data_args(),
             residual_scores,
@@ -781,15 +1021,50 @@ class MatrixFactorizationCoordinate(Coordinate):
         )
         return (u, v), res
 
-    @partial(jax.jit, static_argnums=0)
-    def _score_jit(self, row_idx, col_idx, weights, state) -> Array:
+    def _score_body(self, row_idx, col_idx, weights, state) -> Array:
         u, v = state
         s = jnp.einsum("nk,nk->n", u[row_idx], v[col_idx])
         return jnp.where(weights > 0, s, 0.0)
 
+    @partial(jax.jit, static_argnums=0)
+    def _score_jit(self, row_idx, col_idx, weights, state) -> Array:
+        return self._score_body(row_idx, col_idx, weights, state)
+
     def score(self, state) -> Array:
+        dispatch_count.record(1)
         return self._score_jit(
             self.row_idx, self.col_idx, self.weights, state
+        )
+
+    def _sweep_body(self, data, total, score, state, l2_weight):
+        """Fused CD step (see FixedEffectCoordinate._sweep_body): the joint
+        L-BFGS over both factor tables plus rescore and total update in one
+        program; ``_sweep_jit`` donates ``total``/``score``/``(U, V)``,
+        ``_sweep_jit_nodonate`` is the XLA:CPU variant (see
+        sweep_donation_enabled)."""
+        TRACE_COUNTERS["mf_sweep"] += 1
+        row_idx, col_idx, _, weights, _ = data
+        residual = total - score
+        u, v, res = self._train_body(
+            data, residual, state[0], state[1], l2_weight
+        )
+        new_score = self._score_body(row_idx, col_idx, weights, (u, v))
+        new_total = residual + new_score
+        return (u, v), new_score, new_total, res
+
+    _sweep_jit, _sweep_jit_nodonate = _make_sweep_jits(
+        _sweep_body, static_argnums=0, donate_argnums=(2, 3, 4)
+    )
+
+    def sweep_step(self, total: Array, score: Array, state, donate=None):
+        dispatch_count.record(1)
+        return self._active_sweep_jit(donate)(
+            self,
+            self._data_args(),
+            total,
+            score,
+            state,
+            jnp.asarray(self.l2_weight, self.dtype),
         )
 
     def to_model(self, state) -> MatrixFactorizationModel:

@@ -9,7 +9,12 @@ retrained (partial retraining, :44-49).
 TPU redesign: coordinate scores are dense device arrays aligned by sample
 position, so the residual update is a vectorized subtract/add instead of
 the reference's full-outer-join shuffles (CoordinateDataScores.scala:53-62).
-The Python loop here is pure control flow — every arrow is a jit call.
+The Python loop here is pure control flow — each coordinate's whole step
+(residual → train → rescore → total update) is ONE compiled program
+(``Coordinate.sweep_step``) with the total, the old score, and the old
+state donated, and the steady-state loop runs sync-free: the honest
+read-back barrier (util/force.py — ``block_until_ready`` returns at
+enqueue over the relay) is paid once per SWEEP, not once per coordinate.
 """
 from __future__ import annotations
 
@@ -18,7 +23,10 @@ import logging
 import time
 from typing import Callable, Mapping, Sequence
 
-from photon_tpu.game.coordinate import Coordinate
+import jax
+
+from photon_tpu.game.coordinate import Coordinate, sweep_donation_enabled
+from photon_tpu.util import dispatch_count
 from photon_tpu.util.force import force
 
 logger = logging.getLogger(__name__)
@@ -27,9 +35,29 @@ logger = logging.getLogger(__name__)
 @dataclasses.dataclass
 class CoordinateDescentResult:
     states: dict  # coordinate id → final state
-    tracker: list  # per (iteration, coordinate) log rows
+    tracker: list  # per (iteration, coordinate) + per-sweep log rows
     best_states: dict | None = None  # best-by-validation snapshot
     best_metric: float | None = None
+
+
+@jax.jit
+def _copy_tree_jit(tree):
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+def _copy_device_leaves(tree):
+    """Device-side copy of every array leaf, as ONE compiled program. The
+    fused sweep step DONATES its state buffers, so any array that must
+    outlive the next step (caller-provided warm starts, the
+    best-by-validation snapshot, callback hand-offs) needs its own
+    storage — and on the relay a per-leaf eager copy would pay the ~72 ms
+    dispatch floor per state leaf (~20 at the config-5 shape), so the
+    whole tree copies in a single dispatch, counted like every other
+    sweep-path launch."""
+    dispatch_count.record(1)
+    return _copy_tree_jit(tree)
 
 
 def run_coordinate_descent(
@@ -44,20 +72,61 @@ def run_coordinate_descent(
     start_iteration: int = 0,
     initial_best: tuple[dict, float] | None = None,
     sweep_callback: Callable | None = None,
+    tracker_granularity: str = "sweep",
+    fused: bool = True,
 ) -> CoordinateDescentResult:
     """Run block coordinate descent.
 
     ``validation_fn(states) -> metric`` is evaluated after each full sweep;
     the best snapshot is retained (reference CoordinateDescent tracks the
-    best model by validation evaluator, :240+).
+    best model by validation evaluator, :240+). ``validation_fn`` gets the
+    LIVE state arrays (no copy — it runs every sweep and the built-in
+    scorer only reduces them to a metric): when donation is active it must
+    not retain them or ``np.asarray`` views of them beyond the call — the
+    next sweep consumes those buffers. A validator that needs a lasting
+    snapshot must copy (``jnp.copy`` / ``np.array(x, copy=True)``).
+
+    ``tracker_granularity`` controls where the honest device barrier (a
+    read-back; see util/force.py) lands and therefore what the tracker's
+    ``seconds`` mean:
+
+    - ``"sweep"`` (default): the steady-state path is sync-free — each
+      coordinate's fused step is enqueued back to back and ONE barrier
+      closes the sweep. Per-coordinate rows still carry ``seconds``, but
+      they are ENQUEUE walls (dispatch latency, not device compute); the
+      per-sweep row's ``sweep_seconds`` (barrier-closed) is the honest
+      number, with the barrier's own cost split out as
+      ``barrier_seconds`` and the compiled-program launch count as
+      ``dispatches``.
+    - ``"coordinate"``: opt-in profiling mode — every coordinate's step is
+      closed with its own read-back, so per-coordinate ``seconds`` are
+      honest device walls at the cost of one blocking round trip per
+      coordinate per sweep (~70 ms each over the relay).
+
+    ``fused=False`` forces the unfused reference sequence (one dispatch
+    per arrow, no buffer donation) — the parity oracle for the fused
+    programs and a profiling A/B lever. Under the fused path, tracker
+    ``info`` leaves that alias the live coordinate state (an
+    ``OptimizeResult.x``) are CONSUMED by the next sweep's donation; the
+    scalar counters (``n_evals``, ``iterations``, …) every consumer reads
+    stay valid.
 
     Checkpoint/resume (SURVEY §5.3 — the TPU-native replacement for Spark
     task retry): ``sweep_callback(iteration, states, best_states,
     best_metric)`` fires after every completed sweep so callers can flush
     recovery state; ``start_iteration``/``initial_best`` restart descent
     from a checkpoint. Descent is deterministic given states, so a resumed
-    run is bit-identical to an uninterrupted one.
+    run is bit-identical to an uninterrupted one. Under ``fused`` the
+    callback receives donation-decoupled COPIES of the states (the live
+    arrays are consumed in place by the next sweep — a retained
+    ``np.asarray`` view of them would silently mutate), so callbacks may
+    retain what they receive.
     """
+    if tracker_granularity not in ("sweep", "coordinate"):
+        raise ValueError(
+            f"tracker_granularity must be 'sweep' or 'coordinate', got "
+            f"{tracker_granularity!r}"
+        )
     unknown = [c for c in update_sequence if c not in coordinates]
     if unknown:
         raise ValueError(f"update sequence references unknown coordinates {unknown}")
@@ -65,9 +134,23 @@ def run_coordinate_descent(
         if c not in coordinates:
             raise ValueError(f"locked coordinate {c} not present")
 
-    states = dict(initial_states or {})
+    # donation active ⇒ every structure that must outlive a sweep needs
+    # its own buffers (copies below); donation off (XLA:CPU — see
+    # coordinate.sweep_donation_enabled) ⇒ the copies are skipped
+    donating = fused and sweep_donation_enabled()
+    states = {}
     for cid, coord in coordinates.items():
-        if cid not in states:
+        if initial_states is not None and cid in initial_states:
+            # donation safety: the fused step consumes its state buffers,
+            # and caller-provided arrays (checkpoint resume, λ-grid warm
+            # starts, locked states) must survive this call — one
+            # device-side copy decouples them.
+            states[cid] = (
+                _copy_device_leaves(initial_states[cid])
+                if donating
+                else initial_states[cid]
+            )
+        else:
             states[cid] = coord.initial_state()
 
     # initial scores (locked coordinates contribute through these forever)
@@ -75,25 +158,42 @@ def run_coordinate_descent(
     total = None
     for s in scores.values():
         total = s if total is None else total + s
+    if donating and len(scores) == 1:
+        # single coordinate: total IS that coordinate's score buffer, and
+        # the fused step donates both arguments — donating one buffer
+        # twice is an XLA error, so decouple them once here
+        total = _copy_device_leaves(total)
 
     tracker: list = []
     best_states, best_metric = initial_best or (None, None)
 
     trainable = [c for c in update_sequence if c not in locked_coordinates]
+    per_coordinate = tracker_granularity == "coordinate"
     for it in range(start_iteration, num_iterations):
+        sweep_t0 = time.perf_counter()
+        d0 = dispatch_count.snapshot()
         for cid in trainable:
             coord = coordinates[cid]
             t0 = time.perf_counter()
-            residual = total - scores[cid]
-            new_state, info = coord.train(residual, states[cid])
-            new_score = coord.score(new_state)
-            total = total - scores[cid] + new_score
+            if fused:
+                # donating decided ONCE at entry and threaded through, so
+                # the copy discipline above cannot diverge from the
+                # donation the programs actually perform
+                new_state, new_score, total, info = coord.sweep_step(
+                    total, scores[cid], states[cid], donate=donating
+                )
+            else:
+                new_state, new_score, total, info = Coordinate.sweep_step(
+                    coord, total, scores[cid], states[cid]
+                )
             scores[cid] = new_score
             states[cid] = new_state
-            # block_until_ready can return at enqueue over the relay
-            # (util/force.py) — a read-back is the only honest boundary
-            # for the per-coordinate seconds the tracker reports.
-            force(new_score)
+            if per_coordinate:
+                # a read-back is the only honest boundary for per-
+                # coordinate seconds (block_until_ready can return at
+                # enqueue over the relay, util/force.py) — opt-in: it
+                # costs a blocking round trip per coordinate per sweep
+                force(new_score)
             elapsed = time.perf_counter() - t0
             tracker.append(
                 {
@@ -104,8 +204,28 @@ def run_coordinate_descent(
                 }
             )
             logger.info(
-                "CD iter %d coordinate %s trained in %.3fs", it, cid, elapsed
+                "CD iter %d coordinate %s %s in %.3fs",
+                it,
+                cid,
+                "trained" if per_coordinate else "enqueued",
+                elapsed,
             )
+        barrier_s = 0.0
+        if not per_coordinate:
+            # sync-free steady state: ONE read-back closes the whole sweep
+            # (new_total depends on every coordinate's train + rescore)
+            t0 = time.perf_counter()
+            force(total)
+            barrier_s = time.perf_counter() - t0
+        tracker.append(
+            {
+                "iteration": it,
+                "sweep_seconds": time.perf_counter() - sweep_t0,
+                "barrier_seconds": barrier_s,
+                "dispatches": dispatch_count.snapshot() - d0,
+                "granularity": tracker_granularity,
+            }
+        )
         if validation_fn is not None:
             metric = float(validation_fn(states))
             tracker.append({"iteration": it, "validation": metric})
@@ -114,9 +234,27 @@ def run_coordinate_descent(
                 metric > best_metric if larger_is_better else metric < best_metric
             ):
                 best_metric = metric
-                best_states = dict(states)
+                # the snapshot must own its buffers under donation — the
+                # next sweep consumes the live state arrays
+                best_states = (
+                    {cid: _copy_device_leaves(s) for cid, s in states.items()}
+                    if donating
+                    else dict(states)
+                )
         if sweep_callback is not None:
-            sweep_callback(it, states, best_states, best_metric)
+            # the callback gets its OWN buffers under donation: the next
+            # sweep consumes the live state arrays IN PLACE, and even an
+            # np.asarray taken inside the callback is a zero-copy VIEW of
+            # the device buffer on CPU — it would silently mutate when
+            # XLA reuses the donated storage. One device-side copy per
+            # sweep (only when a callback is installed) restores the
+            # retain-what-you-received contract.
+            cb_states = (
+                {cid: _copy_device_leaves(s) for cid, s in states.items()}
+                if donating
+                else states
+            )
+            sweep_callback(it, cb_states, best_states, best_metric)
 
     return CoordinateDescentResult(
         states=states,

@@ -101,6 +101,11 @@ class GameEstimator:
     mesh: object | None = None
     dtype: object = jnp.float32
     seed: int = 0
+    #: descent tracker barrier placement — "sweep" (default, sync-free
+    #: steady state: one read-back per sweep) or "coordinate" (opt-in
+    #: profiling: honest per-coordinate walls at one blocking round trip
+    #: per coordinate per sweep); see game/descent.run_coordinate_descent
+    tracker_granularity: str = "sweep"
 
     def __post_init__(self):
         missing = [c for c in self.update_sequence if c not in self.coordinate_configs]
@@ -110,6 +115,12 @@ class GameEstimator:
             self.coordinate_configs
         ):
             raise ValueError("locked coordinates must be configured")
+        if self.tracker_granularity not in ("sweep", "coordinate"):
+            # fail at construction, not minutes later inside fit
+            raise ValueError(
+                "tracker_granularity must be 'sweep' or 'coordinate', got "
+                f"{self.tracker_granularity!r}"
+            )
 
     # ------------------------------------------------------------------
 
@@ -331,6 +342,7 @@ class GameEstimator:
                 start_iteration=start_iteration,
                 initial_best=initial_best,
                 sweep_callback=sweep_callback,
+                tracker_granularity=self.tracker_granularity,
             )
             final_states = (
                 cd.best_states if cd.best_states is not None else cd.states

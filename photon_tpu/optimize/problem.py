@@ -165,11 +165,25 @@ class GLMProblem:
         )
 
     def solve(
-        self, batch: LabeledBatch, w0: Array, reg_weight=None
+        self,
+        batch: LabeledBatch,
+        w0: Array,
+        reg_weight=None,
+        *,
+        extra_offsets: Array | None = None,
     ) -> OptimizeResult:
         """Run the solve. ``reg_weight`` may be a traced scalar: passing the
         λ-grid value here (instead of rebuilding the problem per λ) keeps one
-        compiled program per coordinate across the whole grid."""
+        compiled program per coordinate across the whole grid.
+
+        ``extra_offsets`` (e.g. the coordinate-descent residual scores) is
+        folded into the batch offsets INSIDE the program. This is the
+        donation-safe fused-sweep entry: callers hand over the pristine
+        batch plus the residual instead of pre-building a mutated batch
+        pytree, so the offset add fuses into the objective's margin pass
+        and the only [N] temporary is the one XLA schedules."""
+        if extra_offsets is not None:
+            batch = batch._replace(offsets=batch.offsets + extra_offsets)
         cfg = self.config.optimizer_config
         objective = self.objective_for_weight(reg_weight)
         vg = lambda w: objective.value_and_gradient(w, batch)  # noqa: E731

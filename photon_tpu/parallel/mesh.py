@@ -22,6 +22,8 @@ Spark's shuffle service.
 """
 from __future__ import annotations
 
+import inspect
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -30,6 +32,35 @@ from photon_tpu.types import LabeledBatch, PyTree, SparseBatch
 
 BATCH_AXIS = "data"
 ENTITY_AXIS = "entity"
+
+try:  # jax ≥ 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # 0.4.x series (the pinned toolchain)
+    from jax.experimental.shard_map import shard_map
+
+#: the replication/varying-axis checker kwarg was renamed across jax
+#: versions (0.4.x: check_rep; later: check_vma)
+_SHARD_MAP_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+    """``shard_map`` with the replication/varying-axis checker DISABLED,
+    portable across jax versions. Use only where the checker provably
+    mis-rejects per-shard-independent computations (the optimizer while
+    loops mix shard-varying state with constant-initialized history
+    buffers); the real contract is the no-collectives HLO regression test
+    (tests/test_distributed.py)."""
+    return shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_SHARD_MAP_CHECK_KW: False},
+    )
 
 
 def make_mesh(
@@ -70,7 +101,7 @@ def shard_batch(batch, mesh: Mesh, put=None):
     if put is None:
         put = jax.device_put
     axes = tuple(mesh.axis_names)
-    row_sharded = NamedSharding(mesh, P(axes))
+    row_sharded = row_sharding(mesh)  # the layout constrain_rows pins to
     mat_sharded = NamedSharding(mesh, P(axes, None))
     if isinstance(batch, SparseBatch):
         return SparseBatch(
@@ -86,6 +117,27 @@ def shard_batch(batch, mesh: Mesh, put=None):
         offsets=put(batch.offsets, row_sharded),
         weights=put(batch.weights, row_sharded),
     )
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of a per-sample [N, ...] array with rows spread over every
+    mesh device — the layout of batches, scores, and totals."""
+    axes = tuple(mesh.axis_names)
+    return NamedSharding(mesh, P(axes))
+
+
+def constrain_rows(x, mesh: Mesh | None):
+    """Pin a per-sample vector to the mesh's row sharding inside jit.
+
+    The fused sweep step (game/coordinate.py ``_sweep_jit``) chains
+    residual → solve → rescore → total inside ONE program; this constraint
+    keeps the [N] temporaries row-sharded end to end instead of leaving
+    GSPMD free to replicate the chain (at the north-star N that is the
+    difference between an O(N/devices) and an O(N) per-device footprint).
+    No-op off-mesh."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, row_sharding(mesh))
 
 
 def shard_entities(tree: PyTree, mesh: Mesh, axis: int = 0) -> PyTree:

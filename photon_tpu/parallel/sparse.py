@@ -30,8 +30,10 @@ from __future__ import annotations
 
 import jax
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# version-compat shard_map resolved once, in parallel/mesh.py
+from photon_tpu.parallel.mesh import shard_map
 
 from photon_tpu.ops.sparse_windows import ColumnWindows, windowed_rmatvec
 from photon_tpu.types import Array
@@ -79,13 +81,19 @@ def shard_windows(
     """Place the instance axis sharded over every mesh axis (iota
     replicated). Call ``pad_windows_for_mesh`` first if the instance count
     may not divide the mesh."""
+    from photon_tpu.util.device_retry import put_with_retry
+
     axes = tuple(mesh.axis_names)
     windows = pad_windows_for_mesh(
         windows, int(np.prod([mesh.shape[a] for a in axes])), num_features
     )
     inst_sharded = NamedSharding(mesh, P(axes))
     inst_mat = NamedSharding(mesh, P(axes, None))
-    put = jax.device_put
+    # placement wrapped against transient relay UNAVAILABLE, like every
+    # other multi-hundred-MB coordinate-build put (game/coordinate.py)
+    put = lambda x, s: put_with_retry(  # noqa: E731
+        lambda x=x, s=s: jax.device_put(x, s)
+    )
     return ColumnWindows(
         rows=put(windows.rows, inst_mat),
         lcols=put(windows.lcols, inst_mat),

@@ -26,6 +26,20 @@ import numpy as np
 __all__ = ["force"]
 
 
+def _multi_device(leaf) -> bool:
+    """True only for leaves GENUINELY sharded over multiple devices. A leaf
+    without a working ``.devices()`` (host-resident or wrapped arrays in
+    mixed result trees) needs no cross-device care — reading it is free —
+    so it must NOT route the whole tree onto the one-round-trip-per-leaf
+    fallback (ADVICE r5 #3): treat it as host-resident and let the
+    concatenated single-fetch path (with its exception fallback) handle
+    it."""
+    try:
+        return len(leaf.devices()) > 1
+    except Exception:
+        return False
+
+
 def force(tree: Any) -> None:
     """Block until every jax.Array leaf of ``tree`` has actually been
     computed, by reading back one element of each. The per-leaf slices are
@@ -45,29 +59,30 @@ def force(tree: Any) -> None:
         np.asarray(leaves[0].reshape(-1)[0:1])
         return
 
-    def _sharded(leaf) -> bool:
-        try:
-            return len(leaf.devices()) > 1
-        except Exception:
-            return True  # unknown placement: assume sharded, stay safe
-
-    if any(_sharded(leaf) for leaf in leaves):
-        # A barrier must NEVER introduce device collectives: concatenating
-        # slices of multi-device-sharded leaves compiles a cross-device
-        # program whose all-reduce rendezvous starts while the devices'
-        # queues are still drained unevenly — on the single-core virtual
-        # CPU mesh XLA's in-process rendezvous hard-aborts after 40 s of
-        # skew (observed at the 10⁹-coefficient north star). Per-leaf
-        # fetches read from the owning devices directly. The concatenated
-        # single-fetch fast path below is a RELAY optimization (one round
-        # trip), and relay arrays are single-device by construction.
-        for leaf in leaves:
+    # A barrier must NEVER introduce device collectives: concatenating
+    # slices of multi-device-sharded leaves compiles a cross-device
+    # program whose all-reduce rendezvous starts while the devices'
+    # queues are still drained unevenly — on the single-core virtual
+    # CPU mesh XLA's in-process rendezvous hard-aborts after 40 s of
+    # skew (observed at the 10⁹-coefficient north star). Per-leaf
+    # fetches read from the owning devices directly — but ONLY the
+    # genuinely multi-device leaves take that path; the rest keep the
+    # concatenated single-fetch RELAY optimization (one round trip;
+    # relay arrays are single-device by construction).
+    flags = [_multi_device(leaf) for leaf in leaves]
+    for leaf, multi in zip(leaves, flags):
+        if multi:
             np.asarray(leaf.reshape(-1)[0:1])
+    rest = [leaf for leaf, multi in zip(leaves, flags) if not multi]
+    if not rest:
+        return
+    if len(rest) == 1:
+        np.asarray(rest[0].reshape(-1)[0:1])
         return
     try:
         np.asarray(
             jnp.concatenate(
-                [leaf.reshape(-1)[0:1].astype(jnp.float32) for leaf in leaves]
+                [leaf.reshape(-1)[0:1].astype(jnp.float32) for leaf in rest]
             )
         )
     except Exception:
@@ -75,5 +90,5 @@ def force(tree: Any) -> None:
         # trees) or exotic dtypes can make the concatenate raise — the
         # barrier must still hold, so fall back to one fetch per leaf (a
         # round trip each, but correct).
-        for leaf in leaves:
+        for leaf in rest:
             np.asarray(leaf.reshape(-1)[0:1])

@@ -265,3 +265,28 @@ def test_re_train_program_has_no_collectives():
         f"RE train program lowered cross-device collectives {collectives} — "
         "the shard_map per-shard-independent solve contract is broken"
     )
+
+    # the fused MULTI-BUCKET train program (the descent hot path) must
+    # hold the same contract: it composes the same per-shard-independent
+    # shard_map solves, one per bucket, in one module
+    compiled_all = (
+        jax.jit(lambda *a: coord._train_all_jit(*a))
+        .lower(
+            coord._train_args(),
+            jnp.zeros((n,), jnp.float32),
+            coord.initial_state(),
+            jnp.asarray(0.1, jnp.float32),
+        )
+        .compile()
+    )
+    collectives_all = sorted(
+        set(
+            _re.findall(
+                r"all-\w+|collective-\w+|reduce-scatter",
+                compiled_all.as_text(),
+            )
+        )
+    )
+    assert collectives_all == [], (
+        f"fused multi-bucket RE train lowered collectives {collectives_all}"
+    )

@@ -38,3 +38,53 @@ def test_force_large_leaf_reads_one_element_only():
     x = jnp.arange(1 << 20, dtype=jnp.float32)
     force(x)
     assert float(x[123]) == 123.0
+
+
+def test_multi_device_detection_defaults_to_host_resident():
+    """A leaf without a working ``.devices()`` must be treated as
+    host-resident (reading it is free), NOT as sharded — the old
+    assume-sharded default silently routed whole mixed trees onto the
+    one-round-trip-per-leaf fallback (ADVICE r5 #3)."""
+    from photon_tpu.util.force import _multi_device
+
+    class NoDevices:
+        def devices(self):
+            raise AttributeError("host-resident wrapper")
+
+    assert _multi_device(NoDevices()) is False
+    assert _multi_device(jnp.arange(4.0)) is False  # single device
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(num_data=len(jax.devices()))
+    sharded = jax.device_put(
+        np.arange(16, dtype=np.float32), NamedSharding(mesh, P("data"))
+    )
+    assert _multi_device(sharded) is (len(jax.devices()) > 1)
+
+
+def test_force_single_fetch_for_single_device_leaves(monkeypatch):
+    """≥2 single-device leaves must take the concatenated SINGLE-fetch path
+    (one blocking round trip over the relay), even in a tree mixed with
+    numpy leaves."""
+    import jax.numpy as jnp_mod
+
+    calls = []
+    orig = jnp_mod.concatenate
+
+    def counting(*args, **kwargs):
+        calls.append(1)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(jnp_mod, "concatenate", counting)
+    force(
+        {
+            "a": jnp.arange(4, dtype=jnp.float32),
+            "b": jnp.ones((3,), jnp.int32),
+            "c": np.zeros(5),  # host leaf must not break the fast path
+        }
+    )
+    assert len(calls) == 1

@@ -533,9 +533,13 @@ def test_lambda_grid_compiles_once():
         for r in results
     ]
     assert fe_norms[0] > fe_norms[-1]  # λ=10 shrinks vs λ=1e-3
-    assert FixedEffectCoordinate._train_jit._cache_size() == 1
-    n_buckets = len(est._build_coordinates(data)[1]["per-user"].buckets)
-    assert RandomEffectCoordinate._train_bucket._cache_size() == n_buckets
+    # the descent hot path is the FUSED sweep step: one compiled program
+    # per coordinate (all RE buckets ride as pytree leaves of one
+    # program), reused across the whole λ grid because λ is traced
+    assert FixedEffectCoordinate._active_sweep_jit()._cache_size() == 1
+    assert RandomEffectCoordinate._active_sweep_jit()._cache_size() == 1
+    # the initial scoring pass is one multi-bucket program too
+    assert RandomEffectCoordinate._score_all_jit._cache_size() == 1
 
 
 def test_re_build_scales_to_1m_samples():
