@@ -133,6 +133,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="write this run's (weights, evaluation) observations as prior "
         "JSON for future jobs",
     )
+    p.add_argument(
+        "--precompile",
+        action="store_true",
+        help="AOT-compile the fused sweep/score programs on a thread pool "
+        "before descent (independent compiles overlap instead of "
+        "serializing inside the first sweep; pays off when the fit is "
+        "compile-bound — cold caches, relay-tunnelled backends)",
+    )
     p.add_argument("--compute-variance", action="store_true")
     p.add_argument("--model-sparsity-threshold", type=float, default=1e-4)
     p.add_argument(
@@ -433,6 +441,7 @@ def run(argv=None) -> dict:
             ignore_threshold_for_new_models=args.ignore_threshold_for_new_models,
             locked_coordinates=locked,
             validation_evaluator=validation_evaluator,
+            precompile=args.precompile,
         )
 
         emitter.emit("training_start", task=task.name)

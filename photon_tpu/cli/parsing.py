@@ -202,6 +202,11 @@ def parse_coordinate_config(
             if "random.projection.dim" in kv
             else None
         ),
+        # compile-bill governor: total distinct bucket shapes cap
+        # (0 disables; absent → the library default shape budget)
+        shape_budget=(
+            int(kv.pop("shape.budget")) if "shape.budget" in kv else None
+        ),
     )
     if kv.pop("min.partitions", None):
         pass  # partition counts are XLA's concern on TPU; accepted for parity

@@ -96,6 +96,17 @@ class RandomEffectCoordinateConfig:
     #: cap forces costlier ones for on-chip A/B of padding vs program
     #: count. PHOTON_RE_MAX_BUCKETS overrides (<=0 disables entirely).
     max_buckets: int | None = None
+    #: compile-bill governor: cap on the TOTAL distinct (rows, d) bucket
+    #: shapes (split across d-groups when a coordinate/pool mixes widths)
+    #: — each distinct shape is one traced-and-compiled solve
+    #: program, and remote compiles are the dominant fixed cost of a cold
+    #: fit (PERF.md r4: 40-140 s/program through the relay). The row-level
+    #: DP returns its waste-optimal ≤-budget partition, and coordinates
+    #: built under one estimator SHARE one pooled level set (game/data.py
+    #: ShapePool) so near-duplicate shapes across coordinates collapse.
+    #: None → data.DEFAULT_SHAPE_BUDGET; 0 disables (unbudgeted r5
+    #: behavior); PHOTON_RE_SHAPE_BUDGET overrides either way.
+    shape_budget: int | None = None
 
     @property
     def is_random_effect(self) -> bool:

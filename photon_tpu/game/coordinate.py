@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
 from functools import partial
 
 import jax
@@ -48,6 +49,8 @@ from photon_tpu.ops.objective import matvec
 from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
 from photon_tpu.types import Array, LabeledBatch, SparseBatch
 from photon_tpu.util import dispatch_count
+
+logger = logging.getLogger(__name__)
 
 #: Per-program TRACE counters: the Python bodies below bump these, and
 #: Python side effects run only when jit traces — so a steady-state sweep
@@ -158,6 +161,79 @@ class Coordinate:
         if donate is None:
             donate = sweep_donation_enabled()
         return cls._sweep_jit if donate else cls._sweep_jit_nodonate
+
+    # -- AOT precompile support (descent.precompile_coordinates) --------
+    #
+    # ``jit(...).lower(...).compile()`` does NOT feed the jit call cache
+    # on this jax — an AOT-compiled program is only useful if the hot
+    # path actually dispatches it. So precompile stores the Compiled
+    # executables here and ``sweep_step``/``score`` consult the cache
+    # before falling back to the jit path. Keys: ("sweep", donate_bool)
+    # and ("score",). λ rides as a traced argument, so one executable
+    # serves the whole regularization grid.
+
+    def aot_executables(self) -> dict:
+        cache = getattr(self, "_aot_cache", None)
+        if cache is None:
+            cache = self._aot_cache = {}
+        return cache
+
+    def _aot_call(self, key, *args):
+        """Run the precompiled executable for ``key`` on ``args``; None
+        when absent. ONLY call-time argument rejections (aval/sharding
+        mismatch — TypeError/ValueError raised BEFORE execution, so
+        donated buffers survive) drop the executable and fall back to
+        the jit path. Anything else (e.g. a mid-execution runtime error
+        AFTER donation consumed the inputs) propagates — a fallback
+        would re-execute on deleted buffers and mask the real error."""
+        exe = self.aot_executables().get(key)
+        if exe is None:
+            return None
+        try:
+            return exe(*args)
+        except (TypeError, ValueError) as e:
+            self.aot_executables().pop(key, None)
+            logger.warning(
+                "precompiled %s program rejected its inputs (%s: %s); "
+                "falling back to the jit path",
+                key, type(e).__name__, e,
+            )
+            return None
+
+    def precompile_specs(
+        self, donate=None, include_sweep=True, include_score=True
+    ) -> list:
+        """(cache_key, label, Lowered) for every hot-path program a fit
+        dispatches on this coordinate — the enumeration the parallel
+        precompile pass compiles. Lowering happens here (traced once, on
+        the calling thread); the expensive backend compile is the
+        caller's to schedule."""
+        out = []
+        if include_sweep:
+            d = bool(donate) if donate is not None else sweep_donation_enabled()
+            out.append((("sweep", d), "sweep", self._sweep_lowered(d)))
+        if include_score:
+            out.append((("score",), "score", self._score_lowered()))
+        return out
+
+    def _sweep_lowered(self, donate: bool):
+        raise NotImplementedError
+
+    def _score_lowered(self):
+        raise NotImplementedError
+
+    def _row_sds(self, n, template=None):
+        """ShapeDtypeStruct of a per-sample [n] vector, carrying the
+        template's sharding (an AOT executable is specialized to input
+        shardings, so lowering must see the layout the run will use)."""
+        sharding = (
+            template.sharding if isinstance(template, jax.Array) else None
+        )
+        return jax.ShapeDtypeStruct((n,), self.dtype, sharding=sharding)
+
+    #: overridden by the mesh-aware subclasses; the base default keeps
+    #: mesh-free coordinate kinds (MF) working without a field
+    mesh = None
 
     def to_model(self, state):
         raise NotImplementedError
@@ -375,6 +451,9 @@ class FixedEffectCoordinate(Coordinate):
         """x·(w .* factor) + margin shift — the coordinate's contribution,
         exclusive of data offsets (FixedEffectCoordinate.score:158-166)."""
         dispatch_count.record(1)
+        out = self._aot_call(("score",), self.batch, self._norm_args(), state)
+        if out is not None:
+            return out
         return self._score_jit(self.batch, self._norm_args(), state)
 
     def _sweep_body(
@@ -401,11 +480,35 @@ class FixedEffectCoordinate(Coordinate):
         _sweep_body, static_argnums=0, donate_argnums=(3, 4, 5)
     )
 
+    def _state_sds(self):
+        sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(self.mesh, P())  # coefficients replicate
+        return jax.ShapeDtypeStruct(
+            (self.num_features,), self.dtype, sharding=sharding
+        )
+
+    def _sweep_lowered(self, donate: bool):
+        n = self.batch.labels.shape[0]
+        row = self._row_sds(n, self.batch.labels)
+        return self._active_sweep_jit(donate).lower(
+            self, self.batch, self._norm_args(), row, row,
+            self._state_sds(), jax.ShapeDtypeStruct((), self.dtype),
+        )
+
+    def _score_lowered(self):
+        # class-attribute access: the UNBOUND jit function (self rides as
+        # the explicit static arg, like the sweep pair)
+        return type(self)._score_jit.lower(
+            self, self.batch, self._norm_args(), self._state_sds()
+        )
+
     def sweep_step(self, total: Array, score: Array, state: Array,
                    donate=None):
         dispatch_count.record(1)
-        return self._active_sweep_jit(donate)(
-            self,
+        args = (
             self.batch,
             self._norm_args(),
             total,
@@ -413,6 +516,11 @@ class FixedEffectCoordinate(Coordinate):
             state,
             jnp.asarray(self.problem.config.regularization_weight, self.dtype),
         )
+        d = bool(donate) if donate is not None else sweep_donation_enabled()
+        out = self._aot_call(("sweep", d), *args)
+        if out is not None:
+            return out
+        return self._active_sweep_jit(d)(self, *args)
 
     def to_model(self, state: Array) -> FixedEffectModel:
         w = self.normalization.model_to_original_space(state)
@@ -629,11 +737,15 @@ class RandomEffectCoordinate(Coordinate):
         """
         problem = GLMProblem.build(self.problem_config)
         n_res = res_pad.shape[0] - 1
+        # Residual fold OUTSIDE the unchecked region (VERDICT r5 weak #2):
+        # a gather of the replicated residual by shard-varying sample
+        # positions plus an elementwise add partitions fine under plain
+        # GSPMD, so it stays where the compiler's own checks apply.
+        extra = res_pad[jnp.minimum(sample_pos, n_res)]
+        offsets_eff = offsets + extra
 
-        def local_solve(features, labels, offsets, train_weights,
-                        sample_pos, w0, res_pad, reg_weight):
-            extra = res_pad[jnp.minimum(sample_pos, n_res)]
-
+        def vmapped_solve(features, labels, offsets_eff, train_weights,
+                          w0, reg_weight):
             def solve_one(f, l, o, w, w0_e):
                 batch = LabeledBatch(
                     features=f, labels=l, offsets=o, weights=w
@@ -641,31 +753,30 @@ class RandomEffectCoordinate(Coordinate):
                 return problem.solve(batch, w0_e, reg_weight)
 
             return jax.vmap(solve_one)(
-                features, labels, offsets + extra, train_weights, w0
+                features, labels, offsets_eff, train_weights, w0
             )
 
         if self.mesh is None:
-            return local_solve(
-                features, labels, offsets, train_weights, sample_pos, w0,
-                res_pad, reg_weight,
+            return vmapped_solve(
+                features, labels, offsets_eff, train_weights, w0, reg_weight
             )
         from jax.sharding import PartitionSpec as P
 
         from photon_tpu.parallel.mesh import ENTITY_AXIS, shard_map_unchecked
 
         ent = P(ENTITY_AXIS)  # leading axis entity-sharded, rest replicated
-        rep = P()  # residual + λ are replicated on every shard
-        # the optimizer's scan/while carries mix shard-varying state with
-        # constant-initialized history buffers — the VMA/replication
-        # checker rejects that mix even though the computation is per-lane;
+        rep = P()  # λ is replicated on every shard
+        # the unchecked region is EXACTLY the vmapped while-loop solve —
+        # the smallest sub-function the checker mis-handles (this jax has
+        # no replication rule for `while`, and the optimizer carries mix
+        # shard-varying state with constant-initialized history buffers);
         # test_re_train_program_has_no_collectives is the real contract
         return shard_map_unchecked(
-            local_solve,
+            vmapped_solve,
             mesh=self.mesh,
-            in_specs=(ent, ent, ent, ent, ent, ent, rep, rep),
+            in_specs=(ent, ent, ent, ent, ent, rep),
             out_specs=ent,  # every OptimizeResult leaf is per-lane [E, ...]
-        )(features, labels, offsets, train_weights, sample_pos, w0,
-          res_pad, reg_weight)
+        )(features, labels, offsets_eff, train_weights, w0, reg_weight)
 
     @partial(jax.jit, static_argnums=(0,))
     def _train_bucket(
@@ -769,6 +880,9 @@ class RandomEffectCoordinate(Coordinate):
 
     def score(self, state: list[Array]) -> Array:
         dispatch_count.record(1)
+        out = self._aot_call(("score",), self._score_args(), state)
+        if out is not None:
+            return out
         return self._score_all_jit(
             self._score_args(), state, self._pad_slots()
         )
@@ -804,13 +918,66 @@ class RandomEffectCoordinate(Coordinate):
         _sweep_body, static_argnums=(0, 6), donate_argnums=(3, 4, 5)
     )
 
+    def _state_sds_list(self) -> list:
+        ent_sh = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from photon_tpu.parallel.mesh import ENTITY_AXIS
+
+            ent_sh = NamedSharding(self.mesh, P(ENTITY_AXIS, None))
+        return [
+            jax.ShapeDtypeStruct(
+                (db.features.shape[0], db.features.shape[2]),
+                self.dtype,
+                sharding=ent_sh,
+            )
+            for db in self.device_buckets
+        ]
+
+    def _total_sds(self):
+        sharding = None
+        if self.mesh is not None:
+            from photon_tpu.parallel.mesh import row_sharding
+
+            sharding = row_sharding(self.mesh)
+        return jax.ShapeDtypeStruct(
+            (self.num_samples,), self.dtype, sharding=sharding
+        )
+
+    def _sweep_lowered(self, donate: bool):
+        row = self._total_sds()
+        return self._active_sweep_jit(donate).lower(
+            self,
+            self._train_args(),
+            self._score_args(),
+            row,
+            row,
+            self._state_sds_list(),
+            self._pad_slots(),
+            jax.ShapeDtypeStruct((), self.dtype),
+        )
+
+    def _score_lowered(self):
+        return type(self)._score_all_jit.lower(
+            self, self._score_args(), self._state_sds_list(),
+            self._pad_slots(),
+        )
+
     def sweep_step(self, total: Array, score: Array, state: list[Array],
                    donate=None):
         dispatch_count.record(1)
         reg_w = jnp.asarray(
             self.problem_config.regularization_weight, self.dtype
         )
-        return self._active_sweep_jit(donate)(
+        d = bool(donate) if donate is not None else sweep_donation_enabled()
+        out = self._aot_call(
+            ("sweep", d), self._train_args(), self._score_args(), total,
+            score, state, reg_w,
+        )
+        if out is not None:
+            return out
+        return self._active_sweep_jit(d)(
             self,
             self._train_args(),
             self._score_args(),
@@ -1032,6 +1199,11 @@ class MatrixFactorizationCoordinate(Coordinate):
 
     def score(self, state) -> Array:
         dispatch_count.record(1)
+        out = self._aot_call(
+            ("score",), self.row_idx, self.col_idx, self.weights, state
+        )
+        if out is not None:
+            return out
         return self._score_jit(
             self.row_idx, self.col_idx, self.weights, state
         )
@@ -1056,16 +1228,40 @@ class MatrixFactorizationCoordinate(Coordinate):
         _sweep_body, static_argnums=0, donate_argnums=(2, 3, 4)
     )
 
+    def _state_sds_pair(self):
+        k = self.config.num_factors
+        return (
+            jax.ShapeDtypeStruct((len(self.row_vocab), k), self.dtype),
+            jax.ShapeDtypeStruct((len(self.col_vocab), k), self.dtype),
+        )
+
+    def _sweep_lowered(self, donate: bool):
+        row = self._row_sds(self.labels.shape[0], self.labels)
+        return self._active_sweep_jit(donate).lower(
+            self, self._data_args(), row, row, self._state_sds_pair(),
+            jax.ShapeDtypeStruct((), self.dtype),
+        )
+
+    def _score_lowered(self):
+        return type(self)._score_jit.lower(
+            self, self.row_idx, self.col_idx, self.weights,
+            self._state_sds_pair(),
+        )
+
     def sweep_step(self, total: Array, score: Array, state, donate=None):
         dispatch_count.record(1)
-        return self._active_sweep_jit(donate)(
-            self,
+        args = (
             self._data_args(),
             total,
             score,
             state,
             jnp.asarray(self.l2_weight, self.dtype),
         )
+        d = bool(donate) if donate is not None else sweep_donation_enabled()
+        out = self._aot_call(("sweep", d), *args)
+        if out is not None:
+            return out
+        return self._active_sweep_jit(d)(self, *args)
 
     def to_model(self, state) -> MatrixFactorizationModel:
         return MatrixFactorizationModel(

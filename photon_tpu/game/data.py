@@ -291,6 +291,20 @@ class RandomEffectDataset:
     def total_active_samples(self) -> int:
         return int(sum(b.active_mask.sum() for b in self.buckets))
 
+    def shape_stats(self) -> dict:
+        """Compile-bill accounting of the bucketed layout: each bucket is
+        one traced solve sub-program per sweep program, and each DISTINCT
+        (rows, d) shape is one solve program XLA must actually build —
+        the unit the shape budget governs (compile_watch / PERF.md r6)."""
+        shapes = sorted(
+            {(b.padded_samples, b.projected_dim) for b in self.buckets}
+        )
+        return {
+            "bucket_solves": len(self.buckets),
+            "distinct_shapes": len(shapes),
+            "shapes": [list(s) for s in shapes],
+        }
+
     def memory_budget(self, bytes_per_element: int = 4) -> dict:
         """Device-memory accounting for the bucketed layout (VERDICT r2
         weak #4: the HBM footprint must be budgeted, not asserted): per
@@ -386,8 +400,54 @@ def re_bucket_entity_cap() -> int:
     return ent_cap
 
 
+#: default cap on the TOTAL distinct (rows, d) bucket shapes across the
+#: RE coordinates of one fit (split across d-groups — see ShapePool and
+#: _split_shape_budget). Chosen from the measured config-5 CPU-shape
+#: tradeoff curve (PERF.md r6): the pooled level DP at 11 levels cuts
+#: distinct solve shapes 19 → 11 (1.7×) for +0.5 points of padding
+#: waste; 12 is a free lunch (−1.1 points) but saves fewer programs; 10
+#: and below blow the ≤2-point padding budget at bench skew (+2.7
+#: points at 10, +5.9 at 9). At the config-5 NOMINAL shape the curve is
+#: friendlier (both coordinates saturate the 16-level cap): budget 10
+#: projects 2.1× fewer bucket programs at +1.3 points.
+DEFAULT_SHAPE_BUDGET = 11
+
+
+def re_shape_budget(config_value: int | None = None) -> int | None:
+    """Resolve the effective shape budget for one RE coordinate — the cap
+    on the coordinate's (or, pooled, the fit's) TOTAL distinct (rows, d)
+    bucket shapes, split across d-groups (_split_shape_budget).
+
+    Precedence: ``PHOTON_RE_SHAPE_BUDGET`` env (A/B lever; ``0`` disables)
+    > the config's ``shape_budget`` field (``0`` disables) >
+    ``DEFAULT_SHAPE_BUDGET``. Returns None when disabled. Single parse
+    site — the checkpoint fingerprint must hash the same resolution the
+    build uses (a different budget changes the per-bucket state SHAPES,
+    so resuming across it must be the clean stale-config error)."""
+    env = os.environ.get("PHOTON_RE_SHAPE_BUDGET", "").strip()
+    if env:
+        v = int(env)
+        return v if v > 0 else None
+    if config_value is not None:
+        return config_value if config_value > 0 else None
+    return DEFAULT_SHAPE_BUDGET
+
+
+def _split_shape_budget(budget: int | None, n_groups: int) -> int | None:
+    """Per-d-group share of a distinct-shape budget: the budget bounds the
+    TOTAL distinct (rows, d) count, so a multi-width level set splits it.
+    Single definition — ShapePool.freeze and the unpooled per-coordinate
+    fallback must agree, or the same knob means two different caps."""
+    if budget is None or n_groups <= 1:
+        return budget
+    return max(1, budget // n_groups)
+
+
 def _optimal_row_levels(
-    sizes: np.ndarray, waste_target: float = 0.12, max_levels: int = 16
+    sizes: np.ndarray,
+    waste_target: float = 0.12,
+    max_levels: int = 16,
+    shape_budget: int | None = None,
 ) -> np.ndarray:
     """Row-count quantization levels minimizing padded rows.
 
@@ -401,7 +461,15 @@ def _optimal_row_levels(
     compiles are the dominant fixed cost on the relay-tunnelled backend).
     O(U²·K) over U distinct sizes; U is bounded by the active upper bound,
     and single-size datasets short-circuit.
+
+    ``shape_budget`` tightens the level cap below ``max_levels`` (the
+    compile-bill governor, VERDICT r5 next #5): the DP then returns the
+    waste-OPTIMAL ≤-budget partition — strictly better than merging an
+    unbudgeted level set after the fact, because segment boundaries move
+    jointly instead of greedily.
     """
+    if shape_budget is not None:
+        max_levels = min(max_levels, int(shape_budget))
     u, c = np.unique(np.asarray(sizes, dtype=np.int64), return_counts=True)
     U = len(u)
     if U <= 1:
@@ -620,6 +688,132 @@ def _consolidate_shapes(
     )
 
 
+class ShapePool:
+    """Cross-coordinate bucket-shape consolidation (the shape budget).
+
+    Each distinct (rows, d) bucket shape is one traced-and-compiled solve
+    program, and the r5 DP row levels — optimal per coordinate — produce
+    near-duplicate level sets ACROSS coordinates (user {1,2,4,9,23,55,128}
+    vs item {2,4,6,8,11,17,...} at bench skew) that multiply the compile
+    bill for no modeling benefit (VERDICT r5 weak #4 / next #5). The pool
+    runs the row-level DP ONCE per d-group over the POOLED per-entity
+    size distribution of every participating coordinate, so all of them
+    snap to one shared level set. This is provably the padded-cell
+    optimum among all schemes that bound the global distinct-shape count:
+    any scheme is some union level set L that every coordinate snaps up
+    into, and the pooled DP minimizes total padded cells over |L| ≤
+    budget. λ-grid points share shapes by construction (the grid reuses
+    the built coordinates; λ is a traced scalar).
+
+    Protocol: ``observe(d_pad, n_trn)`` per coordinate (from
+    ``profile_random_effect_shapes`` — exact for dense-fast-path and
+    random-projection shards), ``freeze()`` once, then pass the pool to
+    ``build_random_effect_dataset``. Coordinates whose shard cannot be
+    cheaply profiled (general sparse index-compaction: d_proj needs the
+    per-nonzero pair machinery) opt out and fall back to the
+    per-coordinate budgeted DP — they still share any level that
+    coincides, they just don't steer the pooled optimum.
+    """
+
+    def __init__(self, budget: int | None, waste_target: float = 0.12):
+        self.budget = budget
+        self.waste_target = waste_target
+        self._sizes: dict[int, list[np.ndarray]] = {}
+        self._levels: dict[int, np.ndarray] = {}
+        self._frozen = False
+
+    def observe(self, d_pad: np.ndarray, n_trn: np.ndarray) -> None:
+        if self._frozen:
+            raise RuntimeError("ShapePool is frozen")
+        d_pad = np.asarray(d_pad, dtype=np.int64)
+        n_trn = np.asarray(n_trn, dtype=np.int64)
+        for dv in np.unique(d_pad):
+            self._sizes.setdefault(int(dv), []).append(n_trn[d_pad == dv])
+
+    def freeze(self) -> "ShapePool":
+        if not self._frozen:
+            group_budget = _split_shape_budget(self.budget, len(self._sizes))
+            for dv, chunks in self._sizes.items():
+                self._levels[dv] = _optimal_row_levels(
+                    np.concatenate(chunks),
+                    waste_target=self.waste_target,
+                    shape_budget=group_budget,
+                )
+            self._frozen = True
+        return self
+
+    def covers(self, d: int) -> bool:
+        return self._frozen and int(d) in self._levels
+
+    def levels_for(self, d: int, sizes: np.ndarray) -> np.ndarray:
+        """Shared levels for one d-group, extended to cover ``sizes`` (a
+        defensive top-up only — an exact profile already saw them)."""
+        levels = self._levels[int(d)]
+        top = int(np.max(sizes)) if len(sizes) else 0
+        if top > int(levels[-1]):
+            levels = np.concatenate([levels, [top]])
+        return levels
+
+    def stats(self) -> dict:
+        return {
+            "budget": self.budget,
+            "levels_per_d_group": {
+                str(d): [int(x) for x in lv]
+                for d, lv in sorted(self._levels.items())
+            },
+            "distinct_shapes": int(sum(len(lv) for lv in self._levels.values())),
+        }
+
+
+def profile_random_effect_shapes(
+    data: GameData,
+    config: RandomEffectCoordinateConfig,
+    *,
+    existing_model_keys=None,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Cheap exact (d_pad, n_trn) per-entity shape profile of the build —
+    the input ``ShapePool.observe`` needs, WITHOUT the block fills.
+
+    Exact because every input of the bucket-shape decision is
+    deterministic in the entity size histogram: active counts are
+    ``min(counts, upper_bound)`` regardless of which rows the reservoir
+    picks, the entity lower bound reads raw counts, and the projected
+    width is the shard column count (dense fast path) or the fixed
+    random-projection dim. Returns None for shards it cannot profile
+    without the per-nonzero pair machinery (general sparse index
+    compaction / Pearson capping) — those coordinates opt out of pooling.
+    """
+    shard = data.feature_shards[config.feature_shard]
+    if config.projector_type == ProjectorType.RANDOM:
+        d_proj = config.random_projection_dim or 64
+    elif (
+        config.features_to_samples_ratio is None
+        and shard.num_cols > 0
+        and os.environ.get("PHOTON_RE_DENSE_FAST", "1") != "0"
+        and bool(
+            np.all((shard.indptr[1:] - shard.indptr[:-1]) == shard.num_cols)
+        )
+        and _rows_are_canonical(shard.indices, shard.num_rows, shard.num_cols)
+    ):
+        d_proj = shard.num_cols
+    else:
+        return None
+    keys = np.asarray(data.id_tags[config.random_effect_type])
+    valid = keys[keys != PAD_ENTITY_KEY]
+    vocab, counts = np.unique(valid, return_counts=True)
+    entity_kept = counts >= config.active_data_lower_bound
+    if existing_model_keys is not None:
+        has_prior = np.isin(vocab, np.asarray(list(existing_model_keys)))
+        entity_kept = entity_kept | ~has_prior
+    counts = counts[entity_kept]
+    ub = config.active_data_upper_bound
+    n_trn = np.maximum(
+        np.minimum(counts, ub) if ub is not None else counts, 1
+    ).astype(np.int64)
+    d_pad = np.full(len(n_trn), _ceil_pow2(max(int(d_proj), 1)), np.int64)
+    return d_pad, n_trn
+
+
 def build_random_effect_dataset(
     data: GameData,
     config: RandomEffectCoordinateConfig,
@@ -628,6 +822,7 @@ def build_random_effect_dataset(
     intercept_col: int | None = None,
     entity_shards: int = 1,
     existing_model_keys=None,
+    shape_pool: ShapePool | None = None,
 ) -> RandomEffectDataset:
     """Group samples by entity, apply bounds/sampling/projection, bucket.
 
@@ -857,18 +1052,45 @@ def build_random_effect_dataset(
     n_trn = np.maximum(n_act[ent_list], 1)
     d_pad = _ceil_pow2_vec(np.maximum(d_proj[ent_list], 1), floor=8)
     n_lvl = np.empty_like(n_trn)
-    for dv in np.unique(d_pad):
+    budget = re_shape_budget(config.shape_budget)
+    d_groups = np.unique(d_pad)
+    group_budget = _split_shape_budget(budget, len(d_groups))
+    pooled_groups = 0
+    for dv in d_groups:
         grp = d_pad == dv
-        levels = _optimal_row_levels(n_trn[grp])
+        if (
+            budget is not None
+            and shape_pool is not None
+            and shape_pool.covers(int(dv))
+        ):
+            # shared pooled levels: every participating coordinate snaps
+            # into ONE level set, so same-width coordinates contribute
+            # the same (n, d) solve shapes to the compile bill
+            levels = shape_pool.levels_for(int(dv), n_trn[grp])
+            pooled_groups += 1
+        else:
+            levels = _optimal_row_levels(
+                n_trn[grp], shape_budget=group_budget
+            )
         n_lvl[grp] = levels[np.searchsorted(levels, n_trn[grp])]
     combined = _pack_shape_keys(n_lvl, d_pad)
     shape_keys, shape_inv = np.unique(combined, return_inverse=True)
     # consolidation may spend at most the remaining waste budget on top of
     # the DP levels (plus the absolute per-merge cap) — see
-    # _consolidate_shapes
+    # _consolidate_shapes. Under an active shape budget the greedy pass
+    # is SKIPPED (unless a hard cap forces it): the ≤-budget DP / pooled
+    # level set IS the consolidation policy there, and per-coordinate
+    # greedy merges on top would both de-share the cross-coordinate
+    # level set and make a standalone rebuild diverge from the
+    # estimator's pooled build (model buckets must stay reproducible
+    # from (data, config, seed) alone in the single-coordinate case).
     used_cells = int((n_trn * d_pad).sum())
     padded_cells = int((n_lvl * d_pad).sum())
     allowance = max(0, int(0.18 * used_cells) - (padded_cells - used_cells))
+    env_cap = os.environ.get("PHOTON_RE_MAX_BUCKETS", "").strip()
+    hard_cap = config.max_buckets is not None or (
+        env_cap != "" and int(env_cap) > 0
+    )
     merged = (
         _consolidate_shapes(
             shape_keys,
@@ -876,7 +1098,7 @@ def build_random_effect_dataset(
             config.max_buckets,
             cell_allowance=allowance,
         )
-        if len(shape_keys) > 1
+        if len(shape_keys) > 1 and (budget is None or hard_cap)
         else None
     )
     if merged is not None:

@@ -20,16 +20,183 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Mapping, Sequence
 
 import jax
 
 from photon_tpu.game.coordinate import Coordinate, sweep_donation_enabled
-from photon_tpu.util import dispatch_count
+from photon_tpu.util import compile_watch, dispatch_count
 from photon_tpu.util.force import force
 
 logger = logging.getLogger(__name__)
+
+
+def precompile_coordinates(
+    coordinates: Mapping[str, Coordinate],
+    *,
+    donate=None,
+    locked: frozenset = frozenset(),
+    max_workers: int | None = None,
+    include_score: bool = True,
+) -> dict:
+    """AOT-compile every hot-path program a fit will dispatch — all
+    coordinates' fused ``sweep_step`` programs (PR 2's trace-once
+    structure: one program per coordinate with every RE bucket shape as a
+    sub-solve) plus the initial ``score`` programs — on a thread pool, so
+    independent compiles OVERLAP instead of serializing inside the first
+    sweep. XLA releases the GIL during backend compiles, and on a
+    relay-tunnelled backend each compile is a network round trip, so the
+    pool wall approaches the slowest program instead of the sum.
+
+    The compiled executables are stored on each coordinate
+    (``Coordinate.aot_executables``) and dispatched by
+    ``sweep_step``/``score`` — the AOT path is mandatory for the win
+    because ``jit(...).lower().compile()`` does not feed the jit call
+    cache on this jax. λ rides as a traced scalar, so one precompiled
+    set serves the whole regularization grid.
+
+    Locked coordinates get only their score program (they never train).
+    Returns a report: total ``wall_s`` vs ``sum_program_walls_s`` (the
+    overlap evidence), per-program compile walls, and persistent-cache
+    hit counts — what the pass SKIPPED because a previous run already
+    paid for it.
+    """
+    compile_watch.install()
+    t0 = time.perf_counter()
+    specs = []
+    for cid, coord in coordinates.items():
+        try:
+            entries = coord.precompile_specs(
+                donate=donate,
+                include_sweep=cid not in locked,
+                include_score=include_score,
+            )
+        except NotImplementedError:
+            logger.warning("coordinate %s does not support precompile", cid)
+            continue
+        specs.extend(
+            (coord, key, f"{cid}:{label}", lowered)
+            for key, label, lowered in entries
+        )
+    lower_wall_s = time.perf_counter() - t0
+
+    def compile_one(item):
+        coord, key, label, lowered = item
+        try:
+            with compile_watch.thread_scope() as cw:
+                t1 = time.perf_counter()
+                compiled = lowered.compile()
+                wall = time.perf_counter() - t1
+        except Exception as e:
+            # one program's compile failure (transient relay error, OOM)
+            # must not abort the fit — that coordinate simply compiles
+            # lazily on the jit path like an un-precompiled run
+            logger.warning(
+                "precompile of %s failed (%s: %s); the jit path will "
+                "compile it lazily", label, type(e).__name__, e,
+            )
+            return {
+                "program": label,
+                "error": f"{type(e).__name__}: {e}",
+                "wall_s": 0.0,
+                "backend_compile_s": 0.0,
+                "cache_hits": 0,
+                "cache_misses": 0,
+            }
+        coord.aot_executables()[key] = compiled
+        return {
+            "program": label,
+            "wall_s": round(wall, 4),
+            "backend_compile_s": cw["backend_compile_s"],
+            "cache_hits": cw["cache_hits"],
+            "cache_misses": cw["cache_misses"],
+        }
+
+    workers = max_workers or min(8, len(specs) or 1)
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=max(1, workers)) as ex:
+        programs = list(ex.map(compile_one, specs))
+    wall_s = time.perf_counter() - t0
+    report = {
+        "n_programs": len(programs),
+        "max_workers": workers,
+        "lower_wall_s": round(lower_wall_s, 4),
+        "wall_s": round(wall_s, 4),
+        # Σ of per-program walls measured inside their threads: the
+        # serial-equivalent cost. wall_s < this ⇒ compiles overlapped.
+        "sum_program_walls_s": round(sum(p["wall_s"] for p in programs), 4),
+        "cache_hits": sum(p["cache_hits"] for p in programs),
+        "cache_misses": sum(p["cache_misses"] for p in programs),
+        "programs": programs,
+    }
+    logger.info(
+        "precompiled %d programs in %.2fs (serial-equivalent %.2fs, "
+        "%d persistent-cache hits skipped cold compiles)",
+        report["n_programs"], report["wall_s"],
+        report["sum_program_walls_s"], report["cache_hits"],
+    )
+    return report
+
+
+def compile_sec_per_program() -> float:
+    """Assumed cold-compile seconds per program for bill projections:
+    ``PHOTON_COMPILE_SEC_PER_PROGRAM`` override, else 60 s on the
+    relay-tunnelled TPU backend (PERF.md r4 measured 40-140 s at 2^18
+    shapes) and 2 s on local CPU. A projection basis, not a measurement —
+    every consumer records it alongside the projection."""
+    env = os.environ.get("PHOTON_COMPILE_SEC_PER_PROGRAM", "").strip()
+    if env:
+        return float(env)
+    return 60.0 if jax.default_backend() == "tpu" else 2.0
+
+
+def project_compile_bill(
+    n_top_level_programs: int, n_solve_shapes: int
+) -> dict:
+    """THE cold-bill pricing formula, shared by every projector (the
+    built-coordinates path below and bench's pre-build ShapePool path):
+    one unit of XLA work per top-level program plus one per distinct RE
+    solve shape, priced at ``compile_sec_per_program`` each."""
+    sec = compile_sec_per_program()
+    return {
+        "n_top_level_programs": int(n_top_level_programs),
+        "n_solve_shapes": int(n_solve_shapes),
+        "sec_per_program_assumed": sec,
+        "projected_cold_s": round(
+            (n_top_level_programs + n_solve_shapes) * sec, 1
+        ),
+    }
+
+
+def estimate_compile_bill(coordinates: Mapping[str, Coordinate]) -> dict:
+    """Projected cold-cache compile bill for a fit over ``coordinates`` —
+    computable BEFORE anything is enqueued, from the program enumeration
+    alone (VERDICT r5 next #5: config 5's cold bill must be projected up
+    front, not discovered inside a benchmark timeout).
+
+    The basis is explicit and recorded (see ``project_compile_bill``, the
+    single pricing site): 2 top-level programs per coordinate (fused
+    sweep + initial score) plus one unit of XLA work per DISTINCT RE
+    bucket solve shape (each distinct (rows, d) shape is one solve body
+    the compiler must build inside the fused modules — the quantity the
+    shape budget governs).
+    """
+    from photon_tpu.game.coordinate import RandomEffectCoordinate
+
+    shapes = set()
+    n_bucket_solves = 0
+    for coord in coordinates.values():
+        if isinstance(coord, RandomEffectCoordinate):
+            for db in coord.device_buckets:
+                shapes.add(
+                    (int(db.features.shape[1]), int(db.features.shape[2]))
+                )
+                n_bucket_solves += 1
+    bill = project_compile_bill(2 * len(coordinates), len(shapes))
+    return {**bill, "n_bucket_solves": n_bucket_solves}
 
 
 @dataclasses.dataclass
@@ -172,6 +339,7 @@ def run_coordinate_descent(
     for it in range(start_iteration, num_iterations):
         sweep_t0 = time.perf_counter()
         d0 = dispatch_count.snapshot()
+        c0 = compile_watch.snapshot()
         for cid in trainable:
             coord = coordinates[cid]
             t0 = time.perf_counter()
@@ -217,12 +385,19 @@ def run_coordinate_descent(
             t0 = time.perf_counter()
             force(total)
             barrier_s = time.perf_counter() - t0
+        cw = compile_watch.delta(c0)
         tracker.append(
             {
                 "iteration": it,
                 "sweep_seconds": time.perf_counter() - sweep_t0,
                 "barrier_seconds": barrier_s,
                 "dispatches": dispatch_count.snapshot() - d0,
+                # compile share of this sweep's wall (compile_watch): the
+                # steady state must show ~0 here — a nonzero count past
+                # the first sweep means retrace/recompile leaked into the
+                # hot loop (the class of regression PERF.md r6 pins)
+                "compiles": cw["backend_compiles"],
+                "compile_seconds": cw["backend_compile_s"],
                 "granularity": tracker_granularity,
             }
         )

@@ -67,6 +67,11 @@ class GameTrainingResult:
     regularization_weights: dict
     tracker: list
     wall_time_s: float
+    #: compile telemetry for this grid point (util/compile_watch deltas:
+    #: n programs compiled, backend-compile seconds, persistent-cache
+    #: hits/misses), plus the parallel-precompile report on grid 0 when
+    #: ``GameEstimator.precompile`` is on
+    compile_stats: dict | None = None
 
 
 @dataclasses.dataclass
@@ -106,6 +111,15 @@ class GameEstimator:
     #: profiling: honest per-coordinate walls at one blocking round trip
     #: per coordinate per sweep); see game/descent.run_coordinate_descent
     tracker_granularity: str = "sweep"
+    #: AOT-precompile the fused sweep/score programs on a thread pool
+    #: before descent starts (game/descent.precompile_coordinates), so
+    #: independent compiles overlap instead of serializing inside the
+    #: first sweep. λ rides as a traced scalar, so one precompiled
+    #: program set serves the whole regularization grid. Off by default:
+    #: it front-loads the compile bill, which only pays when the fit is
+    #: compile-bound (cold caches, relay-tunnelled backends, many
+    #: coordinates).
+    precompile: bool = False
 
     def __post_init__(self):
         missing = [c for c in self.update_sequence if c not in self.coordinate_configs]
@@ -124,10 +138,69 @@ class GameEstimator:
 
     # ------------------------------------------------------------------
 
-    def _build_coordinates(self, data: GameData, initial_model=None):
+    def _existing_model_keys(self, cid, initial_model):
+        """Prior-model key set for the RE lower-bound bypass (or None when
+        the bypass is off) — needed by both the shape profile and the
+        dataset build, so resolved once."""
+        if not self.ignore_threshold_for_new_models or initial_model is None:
+            return None
+        prior = initial_model.coordinates.get(cid)
+        return (
+            prior.modeled_keys()
+            if isinstance(prior, RandomEffectModel)
+            else set()
+        )
+
+    def _build_shape_pool(self, data: GameData, initial_model=None):
+        """One pooled bucket-shape level set across every RE coordinate
+        (game/data.ShapePool): the cheap profile pass runs before any
+        dataset build so all coordinates snap to shared (rows, d) shapes
+        — strictly fewer distinct solve programs for the compile bill.
+        Coordinates with the budget disabled (shape_budget=0 /
+        PHOTON_RE_SHAPE_BUDGET=0) opt out, as do shards the profile
+        cannot price exactly (general sparse index compaction)."""
+        from photon_tpu.game.data import (
+            ShapePool,
+            profile_random_effect_shapes,
+            re_shape_budget,
+        )
+
+        budgets = []
+        profiles = {}
+        for cid, cfg in self.coordinate_configs.items():
+            if not isinstance(cfg, RandomEffectCoordinateConfig):
+                continue
+            b = re_shape_budget(cfg.shape_budget)
+            if b is None:
+                continue  # budget disabled for this coordinate
+            prof = profile_random_effect_shapes(
+                data,
+                cfg,
+                existing_model_keys=self._existing_model_keys(
+                    cid, initial_model
+                ),
+            )
+            if prof is None:
+                continue  # not exactly profilable: per-coordinate DP
+            budgets.append(b)
+            profiles[cid] = prof
+        if not profiles:
+            return None
+        pool = ShapePool(budget=min(budgets))
+        for d_pad, n_trn in profiles.values():
+            pool.observe(d_pad, n_trn)
+        pool.freeze()
+        logger.info("RE shape pool: %s", pool.stats())
+        return pool
+
+    def _build_coordinates(
+        self, data: GameData, initial_model=None, shape_pool=None
+    ):
         coords = {}
         re_datasets = {}
         norm = self.normalization_contexts or {}
+        if shape_pool is None:
+            shape_pool = self._build_shape_pool(data, initial_model)
         for cid, cfg in self.coordinate_configs.items():
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 coords[cid] = FixedEffectCoordinate.build(
@@ -144,22 +217,15 @@ class GameEstimator:
                     from photon_tpu.parallel.mesh import ENTITY_AXIS
 
                     entity_shards = dict(self.mesh.shape).get(ENTITY_AXIS, 1)
-                existing_keys = None
-                if self.ignore_threshold_for_new_models and initial_model is not None:
-                    # coordinate absent from the prior model → every entity
-                    # is "new" and bypasses the bound (empty key set)
-                    prior = initial_model.coordinates.get(cid)
-                    existing_keys = (
-                        prior.modeled_keys()
-                        if isinstance(prior, RandomEffectModel)
-                        else set()
-                    )
                 ds = build_random_effect_dataset(
                     data,
                     cfg,
                     seed=self.seed,
                     entity_shards=entity_shards,
-                    existing_model_keys=existing_keys,
+                    existing_model_keys=self._existing_model_keys(
+                        cid, initial_model
+                    ),
+                    shape_pool=shape_pool,
                 )
                 re_datasets[cid] = ds
                 coords[cid] = RandomEffectCoordinate.build(
@@ -200,6 +266,7 @@ class GameEstimator:
         grid_callback=None,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 1,
+        shape_pool=None,
     ) -> list[GameTrainingResult]:
         """Train one GameModel per λ-grid point, warm-starting across the
         grid (reference fit :304-390 + train :746).
@@ -217,6 +284,12 @@ class GameEstimator:
         models the previous run flushed through ``grid_callback``) and
         produces bit-identical models. Entries for skipped grid points are
         ``None`` in the returned list.
+
+        ``shape_pool`` injects a prebuilt RE bucket-shape pool (from
+        ``_build_shape_pool`` on the SAME data/initial model) so callers
+        that already profiled shapes — e.g. bench's projected-bill pass —
+        don't pay the profile + DP twice and are guaranteed the fit
+        buckets exactly as they priced.
         """
         if self.ignore_threshold_for_new_models and initial_model is None:
             raise ValueError(
@@ -227,7 +300,19 @@ class GameEstimator:
             from photon_tpu.game.data import pad_game_data
 
             data = pad_game_data(data, int(self.mesh.devices.size))
-        coordinates, re_datasets = self._build_coordinates(data, initial_model)
+        coordinates, re_datasets = self._build_coordinates(
+            data, initial_model, shape_pool=shape_pool
+        )
+
+        from photon_tpu.util import compile_watch
+
+        precompile_report = None
+        if self.precompile:
+            from photon_tpu.game.descent import precompile_coordinates
+
+            precompile_report = precompile_coordinates(
+                coordinates, locked=self.locked_coordinates
+            )
 
         init_states = None
         if initial_model is not None:
@@ -258,7 +343,10 @@ class GameEstimator:
 
             # stale-config guard: resuming state trained under different
             # hyperparameters must be a hard error, not silent reuse
-            from photon_tpu.game.data import re_bucket_entity_cap
+            from photon_tpu.game.data import (
+                re_bucket_entity_cap,
+                re_shape_budget,
+            )
 
             fingerprint = repr(
                 (
@@ -272,12 +360,18 @@ class GameEstimator:
                     sorted(self.locked_coordinates),
                     self.seed,
                     data.num_samples,
-                    # layout knob: a different bucket-entity cap changes the
-                    # per-bucket state SHAPES — resuming across it must be
-                    # the clean stale-config error, not a cryptic unflatten
-                    # failure. Normalized via the build's own parse site so
-                    # equivalent configs never spuriously invalidate.
+                    # layout knobs: a different bucket-entity cap or shape
+                    # budget changes the per-bucket state SHAPES — resuming
+                    # across either must be the clean stale-config error,
+                    # not a cryptic unflatten failure. Normalized via the
+                    # build's own parse sites so equivalent configs never
+                    # spuriously invalidate (the env overrides ride along).
                     re_bucket_entity_cap(),
+                    sorted(
+                        (cid, re_shape_budget(cfg.shape_budget))
+                        for cid, cfg in self.coordinate_configs.items()
+                        if isinstance(cfg, RandomEffectCoordinateConfig)
+                    ),
                 )
             )
             checkpointer = DescentCheckpointer(
@@ -327,23 +421,24 @@ class GameEstimator:
                     )
                 )
 
-            cd = run_coordinate_descent(
-                coords_gi,
-                self.update_sequence,
-                self.descent_iterations,
-                initial_states=states,
-                locked_coordinates=self.locked_coordinates,
-                validation_fn=validation_fn,
-                larger_is_better=(
-                    self.validation_evaluator.larger_is_better
-                    if self.validation_evaluator
-                    else True
-                ),
-                start_iteration=start_iteration,
-                initial_best=initial_best,
-                sweep_callback=sweep_callback,
-                tracker_granularity=self.tracker_granularity,
-            )
+            with compile_watch.watch() as grid_compiles:
+                cd = run_coordinate_descent(
+                    coords_gi,
+                    self.update_sequence,
+                    self.descent_iterations,
+                    initial_states=states,
+                    locked_coordinates=self.locked_coordinates,
+                    validation_fn=validation_fn,
+                    larger_is_better=(
+                        self.validation_evaluator.larger_is_better
+                        if self.validation_evaluator
+                        else True
+                    ),
+                    start_iteration=start_iteration,
+                    initial_best=initial_best,
+                    sweep_callback=sweep_callback,
+                    tracker_granularity=self.tracker_granularity,
+                )
             final_states = (
                 cd.best_states if cd.best_states is not None else cd.states
             )
@@ -356,6 +451,12 @@ class GameEstimator:
                 regularization_weights=reg_weights,
                 tracker=cd.tracker,
                 wall_time_s=time.perf_counter() - t_grid,
+                compile_stats={
+                    **grid_compiles,
+                    # the parallel-precompile bill was paid once, before
+                    # grid 0 — later grid points reuse its executables
+                    "precompile": precompile_report if gi == 0 else None,
+                },
             )
             results.append(result)
             if grid_callback is not None:

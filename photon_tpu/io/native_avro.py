@@ -171,7 +171,15 @@ class _CDecoded(ctypes.Structure):
         ("bag_key_ids", ctypes.POINTER(ctypes.POINTER(ctypes.c_int32))),
         ("bag_vals", ctypes.POINTER(ctypes.POINTER(ctypes.c_double))),
         ("bag_nkeys", ctypes.POINTER(ctypes.c_int64)),
-        ("bag_key_pool", ctypes.POINTER(ctypes.c_char_p)),
+        # char** on the C side, bound as void* addresses ON PURPOSE:
+        # indexing a POINTER(c_char_p) materializes a TEMPORARY Python
+        # bytes copy (read to the first NUL), and taking a pointer into
+        # that temporary then reading it later is a use-after-free — the
+        # key pool intermittently decoded as heap garbage once the
+        # process had enough allocation churn (every feature key then
+        # missed the index map and scoring collapsed to intercept-only).
+        # An address stays valid until pml_avro_free.
+        ("bag_key_pool", ctypes.POINTER(ctypes.c_void_p)),
         ("bag_key_offs", ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))),
         ("uid_pool", ctypes.POINTER(ctypes.c_char)),
         ("uid_offs", ctypes.POINTER(ctypes.c_int64)),
@@ -219,6 +227,10 @@ def _arr(ptr, n, dtype):
 
 
 def _pool_strings(pool_ptr, offs: np.ndarray) -> list[str]:
+    """Slice a concatenated C string pool into Python strings. ``pool_ptr``
+    must reference the C-owned buffer directly (a POINTER(c_char) field or
+    a raw address) — never a pointer into a temporary Python bytes object,
+    which is freed before the read (the bag_key_pool UAF above)."""
     total = int(offs[-1]) if len(offs) else 0
     raw = ctypes.string_at(pool_ptr, total) if total else b""
     return [
@@ -276,10 +288,8 @@ def decode_file(path: str, program: bytes, bag_order: Sequence[str]):
             vals = _arr(d.bag_vals[bi], nnz, np.float64)
             nk = int(d.bag_nkeys[bi])
             koffs = _arr(d.bag_key_offs[bi], nk + 1, np.int64)
-            pool_ptr = ctypes.cast(
-                d.bag_key_pool[bi], ctypes.POINTER(ctypes.c_char)
-            )
-            keys = _pool_strings(pool_ptr, koffs)
+            # raw address into C-owned memory (valid until pml_avro_free)
+            keys = _pool_strings(d.bag_key_pool[bi] or 0, koffs)
             bags[bag_name] = (indptr, key_ids, vals, keys)
         n_meta = int(d.n_meta)
         meta_rows = _arr(d.meta_row, n_meta, np.int64)

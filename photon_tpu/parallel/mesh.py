@@ -49,11 +49,14 @@ _SHARD_MAP_CHECK_KW = (
 
 def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
     """``shard_map`` with the replication/varying-axis checker DISABLED,
-    portable across jax versions. Use only where the checker provably
-    mis-rejects per-shard-independent computations (the optimizer while
-    loops mix shard-varying state with constant-initialized history
-    buffers); the real contract is the no-collectives HLO regression test
-    (tests/test_distributed.py)."""
+    portable across jax versions. Scope it to the SMALLEST sub-function
+    the checker provably mis-handles — today that is exactly the vmapped
+    optimizer while-loop solve (this jax has no replication rule for
+    ``while``, and the carries mix shard-varying state with constant-
+    initialized history buffers); surrounding gathers/elementwise work
+    belongs under plain GSPMD where the compiler's checks apply. The real
+    contract is the no-collectives HLO regression test
+    (tests/test_distributed.py::test_re_train_program_has_no_collectives)."""
     return shard_map(
         f,
         mesh=mesh,

@@ -25,7 +25,12 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-from bench import CONFIG_PLAN, _probe_tpu, launch_config_worker  # noqa: E402
+from bench import (  # noqa: E402
+    CONFIG_PLAN,
+    _probe_tpu,
+    check_quality_bands,
+    launch_config_worker,
+)
 
 _PARTIAL = os.path.join(_REPO, "BENCH_partial.json")
 #: orchestrator budgets + headroom: a standalone rerun tolerates one cold
@@ -90,6 +95,13 @@ def main() -> int:
             continue
         if detail.get("backend") != "tpu":
             print(f"[rerun] {name} ran on {detail.get('backend')}; "
+                  "keeping stale entry", flush=True)
+            continue
+        violations = check_quality_bands(name, detail)
+        if violations:
+            # same gate as the orchestrator: a rerun must not replace a
+            # healthy stale row with a fast-but-garbage one
+            print(f"[rerun] {name} quality band violated: {violations}; "
                   "keeping stale entry", flush=True)
             continue
         results["configs"][name] = detail

@@ -1,0 +1,123 @@
+"""Quality-band gating of bench configs (bench.QUALITY_BANDS /
+check_quality_bands; VERDICT r5 next #6): a config that produces a
+throughput number while its model is garbage must FAIL the run, not
+publish. The poisoned cases below are built from REAL solves whose
+optimization was sabotaged, not hand-typed dicts — the band has to catch
+the failure mode as it would actually appear.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+import bench
+from photon_tpu.ops.losses import LogisticLoss
+from photon_tpu.ops.objective import GLMObjective
+from photon_tpu.optimize import OptimizerConfig, minimize_lbfgs
+from photon_tpu.types import LabeledBatch
+
+
+def _a1a_like_batch(n=400, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    labels = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w_true)))).astype(
+        np.float32
+    )
+    return LabeledBatch(
+        features=jnp.asarray(x),
+        labels=jnp.asarray(labels),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+
+
+def _solve(batch, max_iterations):
+    obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+    return minimize_lbfgs(
+        None,
+        jnp.zeros((batch.features.shape[1],), jnp.float32),
+        OptimizerConfig(max_iterations=max_iterations, tolerance=1e-7),
+        oracle=obj.directional_oracle(batch),
+    )
+
+
+def test_healthy_solve_passes_gnorm_band():
+    res = _solve(_a1a_like_batch(), max_iterations=100)
+    detail = {
+        "converged_reason": int(res.reason),
+        "gnorm_final": float(jnp.linalg.norm(res.gradient)),
+        "scale": "cpu",
+    }
+    assert detail["converged_reason"] in bench._CONVERGED_REASONS
+    assert bench.check_quality_bands("a1a_logistic_lbfgs", detail) == []
+
+
+def test_poisoned_solve_fails_gnorm_band():
+    """A solver that CLAIMS tolerance convergence while having barely
+    optimized (here: the gradient at a 1-iteration stop) must trip the
+    band — this is exactly the silent-quality-rot the gate exists for."""
+    res = _solve(_a1a_like_batch(), max_iterations=1)
+    poisoned = {
+        "converged_reason": 2,  # the lie: "function values converged"
+        "gnorm_final": float(jnp.linalg.norm(res.gradient)),
+        "scale": "cpu",
+    }
+    violations = bench.check_quality_bands("a1a_logistic_lbfgs", poisoned)
+    assert violations, poisoned
+    assert "gnorm_final" in violations[0]
+
+
+def test_max_iteration_stop_is_not_a_band_failure():
+    """Reduced CPU shapes legitimately stop on the iteration cap with a
+    large gradient — slow is not wrong, so the gnorm band must not fire."""
+    res = _solve(_a1a_like_batch(), max_iterations=1)
+    detail = {
+        "converged_reason": 1,  # MAX_ITERATIONS, honestly reported
+        "gnorm_final": float(jnp.linalg.norm(res.gradient)),
+        "scale": "cpu",
+    }
+    assert bench.check_quality_bands("a1a_logistic_lbfgs", detail) == []
+
+
+def _grouped_auc(scores, labels, ids):
+    from photon_tpu.evaluation import MultiEvaluator
+
+    return float(MultiEvaluator.auc("user")(scores, labels, ids))
+
+
+def test_poisoned_game_scores_fail_auc_band():
+    rng = np.random.default_rng(1)
+    n, users = 2000, 40
+    ids = np.asarray([f"u{i}" for i in rng.integers(0, users, size=n)])
+    margin = rng.normal(size=n) * 2.0
+    labels = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    healthy = {
+        "scale": "cpu",
+        "grouped_auc": {"value": _grouped_auc(margin, labels, ids)},
+    }
+    # the poison: a sign flip in the scoring path — the classic silent
+    # model-assembly bug a throughput metric would never notice
+    poisoned = {
+        "scale": "cpu",
+        "grouped_auc": {"value": _grouped_auc(-margin, labels, ids)},
+    }
+    assert bench.check_quality_bands("game_ctr_scale", healthy) == []
+    violations = bench.check_quality_bands("game_ctr_scale", poisoned)
+    assert violations and "grouped_auc" in violations[0]
+
+
+def test_missing_or_nan_auc_fails_band():
+    assert bench.check_quality_bands(
+        "glmix_game_estimator", {"scale": "cpu", "grouped_auc": None}
+    )
+    assert bench.check_quality_bands(
+        "glmix_game_estimator",
+        {"scale": "cpu", "grouped_auc": {"value": float("nan")}},
+    )
+
+
+def test_bands_cover_every_config():
+    """Every config in the plan carries a band — adding a config without
+    deciding its quality contract should fail loudly here."""
+    for name, _, _ in bench.CONFIG_PLAN:
+        assert name in bench.QUALITY_BANDS, name

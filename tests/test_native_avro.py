@@ -304,3 +304,39 @@ def test_native_scoring_writer_parity(tmp_path, with_optional):
     finally:
         del os.environ["PHOTON_NO_NATIVE_AVRO"]
     assert read_avro_file(p_native) == read_avro_file(p_python)
+
+
+def test_decode_key_pool_stable_under_heap_churn(tmp_path):
+    """Regression pin for the bag-key-pool use-after-free: the old ctypes
+    binding indexed the ``char**`` pool as POINTER(c_char_p), which
+    materializes a TEMPORARY Python bytes copy, then read key bytes
+    through a pointer into that freed temporary — keys intermittently
+    decoded as heap garbage once the process had allocation churn, every
+    feature then missed the index map, and scoring collapsed to
+    intercept-only (observed as a 0.44 AUC flake in the scoring-driver
+    test). The binding must read the C-owned pool directly; repeated
+    decodes with interleaved allocation churn must yield identical,
+    valid key vocabularies.
+    """
+    from photon_tpu.io.avro import read_schema
+    from photon_tpu.io.native_avro import compile_program, decode_file
+
+    p = tmp_path / "part-00000.avro"
+    write_avro_file(p, TRAINING_EXAMPLE_AVRO, _records(3, n=150))
+    compiled = compile_program(read_schema(p), ["features"])
+    assert compiled is not None
+    program, bag_order = compiled
+    first = decode_file(p, program, bag_order)
+    if first is None:
+        import pytest
+
+        pytest.skip("native decoder unavailable")
+    expect = first.bags["features"][3]
+    assert expect and all("\x00" not in k for k in expect)
+    for trial in range(15):
+        # churn: force allocator reuse of recently-freed small buffers,
+        # the condition under which the UAF used to surface
+        garbage = [bytes(57 + trial) * 3 for _ in range(200)]
+        df = decode_file(p, program, bag_order)
+        assert df.bags["features"][3] == expect, f"trial {trial}"
+        del garbage
