@@ -963,13 +963,25 @@ def _run_game_config(
     fe_max_iter,
     re_max_iter,
     seed=0,
+    config_name="game",
 ):
     """Build skewed GAME data and run GameEstimator.fit; returns detail dict.
 
     ``coords_spec``: list of (name, num_entities, d_re, upper_bound).
     The FE shard is sparse when fe_nnz < fe_dim (AUTO picks the layout).
+
+    Telemetry: the run executes with the obs spine enabled and exports a
+    per-config run profile (Chrome trace + metrics + JSONL manifest)
+    under ``$PHOTON_OBS_DIR`` (default ``bench_obs/``); the returned row
+    carries the artifact paths and the per-phase wall split as ``obs``.
     """
     import numpy as np
+
+    from photon_tpu import obs
+
+    # one artifact set per config run: clean slate, then enable
+    obs.reset()
+    obs.enable()
 
     from photon_tpu.game.config import (
         FixedEffectCoordinateConfig,
@@ -1179,7 +1191,8 @@ def _run_game_config(
     # every entity of the first RE coordinate — the MultiEvaluator lexsort/
     # segment kernels at bench scale)
     t0 = time.perf_counter()
-    scores = np.asarray(result.model.score(data))
+    with obs.span("bench.score"):
+        scores = np.asarray(result.model.score(data))
     score_wall = time.perf_counter() - t0
     from photon_tpu.evaluation import MultiEvaluator
 
@@ -1196,7 +1209,8 @@ def _run_game_config(
         ev_ids,
     )
     t0 = time.perf_counter()
-    grouped_auc = ev_fn(scores, labels, ev_ids)
+    with obs.span("bench.grouped_eval"):
+        grouped_auc = ev_fn(scores, labels, ev_ids)
     grouped_wall = time.perf_counter() - t0
 
     # steady-state sweep time: tracker iterations >= 1 (iteration 0 pays
@@ -1270,11 +1284,38 @@ def _run_game_config(
         },
     }
 
+    # telemetry artifacts: one Chrome trace + metrics snapshot + JSONL
+    # manifest + summary per config (open the trace at
+    # https://ui.perfetto.dev), plus the per-phase wall split inline in
+    # the row — same exporter the CLI drivers use
+    from photon_tpu.obs import phase_summary, summary_table
+
+    obs_dir = os.environ.get("PHOTON_OBS_DIR", "bench_obs")
+    paths = obs.export_artifacts(
+        obs_dir,
+        prefix=f"{config_name}.",
+        meta={"config": config_name, "n": n},
+    )
+    obs_detail = {
+        "trace_path": paths["trace"],
+        "metrics_path": paths["metrics"],
+        "manifest_path": paths["manifest"],
+        "phase_wall_s": {
+            name: agg["total_s"] for name, agg in phase_summary().items()
+        },
+    }
+    _log("[bench] run profile:\n" + summary_table())
+    # artifact written — telemetry back off so non-GAME configs run (and
+    # are timed) unprofiled, and spans don't accumulate across configs
+    obs.disable()
+    obs.reset()
+
     return {
         "n": n,
         "fe_dim": fe_dim,
         "fe_nnz": fe_nnz,
         "value_entropy": value_entropy,
+        "obs": obs_detail,
         "fe_layout": "sparse_ell" if fe_nnz < fe_dim else "dense",
         "coordinates": {
             name: {"num_entities": ne, "d_re": dr, "active_upper_bound": ub}
@@ -1344,6 +1385,7 @@ def config_glmix_estimator(peak_flops, scale):
         descent_iterations=_pick(scale, 2, 3, 3),
         fe_max_iter=_pick(scale, 5, 20, 20),
         re_max_iter=_pick(scale, 3, 10, 10),
+        config_name="glmix_game_estimator",
     )
 
 
@@ -1365,6 +1407,7 @@ def config_game_ctr_scale(peak_flops, scale):
         descent_iterations=2,  # iteration 1 = steady state (post-compile)
         fe_max_iter=_pick(scale, 4, 8, 10),
         re_max_iter=_pick(scale, 3, 4, 5),
+        config_name="game_ctr_scale",
     )
 
 

@@ -4,6 +4,7 @@ and date-ranged input resolution."""
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 
 from photon_tpu.cli.parsing import (
@@ -122,3 +123,57 @@ def ensure_single_process_jax() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+
+@contextlib.contextmanager
+def run_profile():
+    """Telemetry session for one driver run: enable the spine
+    (photon_tpu/obs) from a clean slate on entry, and ALWAYS disable and
+    drop the recorded spans on exit — success or failure — so a
+    long-lived process embedding a driver never keeps profiling (and
+    accumulating spans for) unrelated work after the run. Drivers
+    profile by default — the measured overhead is <2% of a steady sweep
+    (PERF.md r7) and the artifacts are what make a slow run debuggable
+    after the fact. Artifacts must be exported inside the session
+    (``export_run_profile``).
+
+    ``PHOTON_OBS=0`` opts the driver out of MANAGING the pipeline
+    entirely: nothing is enabled on entry and — just as important —
+    nothing is disabled or dropped on exit, so an embedding process
+    that runs its own library-level telemetry (``obs.enable()``) keeps
+    its state and its accumulated spans across a driver call."""
+    from photon_tpu import obs
+
+    if os.environ.get("PHOTON_OBS", "").strip() == "0":
+        yield
+        return
+    obs.enable()
+    obs.reset()
+    try:
+        yield
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def export_run_profile(out_root, log=None, meta=None) -> dict | None:
+    """Write this run's telemetry artifacts under ``<out_root>/obs/``:
+    Chrome trace-event JSON (open at https://ui.perfetto.dev or
+    chrome://tracing), the metrics snapshot, the JSONL run manifest, and
+    the human-readable per-phase summary. No-op (returns None) when
+    telemetry is disabled.
+
+    Call inside a :func:`run_profile` session (which owns the
+    enable/disable lifecycle — including the failure path, where no
+    artifacts are written but telemetry still shuts off)."""
+    from photon_tpu import obs
+
+    if not obs.enabled():
+        return None
+    paths = obs.export_artifacts(
+        os.path.join(str(out_root), "obs"), meta=meta
+    )
+    if log is not None:
+        log.info("run profile:\n%s", obs.summary_table())
+        log.info("telemetry artifacts: %s", paths)
+    return paths

@@ -44,7 +44,7 @@ def run(argv=None) -> dict:
         args.root_output_directory, override=args.override_output_directory
     )
     emitter = EventEmitter()
-    with PhotonLogger(
+    with game_base.run_profile(), PhotonLogger(
         os.path.join(out_root, "driver.log"), level=args.log_level
     ) as log:
         emitter.emit("setup", application=args.application_name)
@@ -124,6 +124,9 @@ def run(argv=None) -> dict:
             json.dump(
                 {"numScored": n, "evaluations": evaluations}, f, indent=2
             )
+        game_base.export_run_profile(
+            out_root, log, meta={"driver": "game_scoring"}
+        )
         emitter.emit("scoring_finish", num_scored=n)
     emitter.close()
     return {"scores": scores, "evaluations": evaluations, "output": out_root}

@@ -373,9 +373,15 @@ def run(argv=None) -> dict:
             args.root_output_directory, override=args.override_output_directory
         )
     emitter = EventEmitter()
-    with PhotonLogger(
+    with game_base.run_profile(), PhotonLogger(
         os.path.join(out_root, "driver.log"), level=args.log_level
     ) as log:
+        # driver-level boundary (fires even when the run fails before
+        # fit); the estimator adds the PER-FIT lifecycle events on this
+        # same bus (events=emitter below) — ``setup`` with coordinate
+        # payloads, ``sweep_complete``, ``training_finish``. A run's
+        # overall completion signal (post-tuning, models on disk) is
+        # ``driver_finish``.
         emitter.emit("setup", application=args.application_name)
 
         with Timed("read training data"):
@@ -442,6 +448,9 @@ def run(argv=None) -> dict:
             locked_coordinates=locked,
             validation_evaluator=validation_evaluator,
             precompile=args.precompile,
+            # library-level lifecycle events (setup / sweep_complete /
+            # training_finish / training_failure) ride the driver's bus
+            events=emitter,
         )
 
         emitter.emit("training_start", task=task.name)
@@ -517,14 +526,13 @@ def run(argv=None) -> dict:
             # with a validation evaluation is a usable prior
             from photon_tpu.hyperparameter.serialization import priors_to_json
 
-            obs = [
+            observations = [
                 (r.regularization_weights, float(r.evaluation))
                 for r in results
                 if r.evaluation is not None
             ]
             with open(args.hyper_parameter_save_observations, "w") as f:
-                f.write(priors_to_json(obs))
-        emitter.emit("training_finish", num_models=len(results))
+                f.write(priors_to_json(observations))
 
         best = _select_best(results, validation_evaluator)
         log.info(
@@ -577,7 +585,12 @@ def run(argv=None) -> dict:
             json.dump(
                 {"models": opt_summary, "best": best, "task": task.name}, f, indent=2
             )
-        emitter.emit("driver_finish")
+        game_base.export_run_profile(
+            out_root, log, meta={"driver": "game_training"}
+        )
+        # overall run completion: includes tuned models, unlike the
+        # estimator's per-fit training_finish
+        emitter.emit("driver_finish", num_models=len(results))
     emitter.close()
     return {"results": results, "best": best, "output": out_root}
 

@@ -27,6 +27,7 @@ from typing import Callable, Mapping, Sequence
 
 import jax
 
+from photon_tpu import obs
 from photon_tpu.game.coordinate import Coordinate, sweep_donation_enabled
 from photon_tpu.util import compile_watch, dispatch_count
 from photon_tpu.util.force import force
@@ -86,7 +87,9 @@ def precompile_coordinates(
     def compile_one(item):
         coord, key, label, lowered = item
         try:
-            with compile_watch.thread_scope() as cw:
+            with compile_watch.thread_scope() as cw, obs.span(
+                "precompile.program", cat="compile", program=label
+            ):
                 t1 = time.perf_counter()
                 compiled = lowered.compile()
                 wall = time.perf_counter() - t1
@@ -239,6 +242,7 @@ def run_coordinate_descent(
     start_iteration: int = 0,
     initial_best: tuple[dict, float] | None = None,
     sweep_callback: Callable | None = None,
+    sweep_hook: Callable | None = None,
     tracker_granularity: str = "sweep",
     fused: bool = True,
 ) -> CoordinateDescentResult:
@@ -288,6 +292,20 @@ def run_coordinate_descent(
     arrays are consumed in place by the next sweep — a retained
     ``np.asarray`` view of them would silently mutate), so callbacks may
     retain what they receive.
+
+    ``sweep_hook(iteration, row)`` fires right after each per-sweep
+    tracker row is appended, with the row itself. Unlike
+    ``sweep_callback`` it carries NO states, so installing one adds no
+    donation-decoupling copies (zero extra dispatches) — the estimator
+    uses it to emit ``sweep_complete`` lifecycle events.
+
+    Telemetry (photon_tpu/obs): each coordinate step, the sweep, the
+    read-back barrier, validation, and the checkpoint callback run
+    inside tracer spans, and the tracker rows are derived FROM those
+    spans (``seconds``/``sweep_seconds`` are span durations) — same
+    fields as always, one clock. With telemetry disabled the spans
+    reduce to bare monotonic clock reads; nothing extra is dispatched
+    or read back in either mode.
     """
     if tracker_granularity not in ("sweep", "coordinate"):
         raise ValueError(
@@ -321,10 +339,13 @@ def run_coordinate_descent(
             states[cid] = coord.initial_state()
 
     # initial scores (locked coordinates contribute through these forever)
-    scores = {cid: coordinates[cid].score(states[cid]) for cid in coordinates}
-    total = None
-    for s in scores.values():
-        total = s if total is None else total + s
+    with obs.span("descent.initial_score", coordinates=len(coordinates)):
+        scores = {
+            cid: coordinates[cid].score(states[cid]) for cid in coordinates
+        }
+        total = None
+        for s in scores.values():
+            total = s if total is None else total + s
     if donating and len(scores) == 1:
         # single coordinate: total IS that coordinate's score buffer, and
         # the fused step donates both arguments — donating one buffer
@@ -337,72 +358,94 @@ def run_coordinate_descent(
     trainable = [c for c in update_sequence if c not in locked_coordinates]
     per_coordinate = tracker_granularity == "coordinate"
     for it in range(start_iteration, num_iterations):
-        sweep_t0 = time.perf_counter()
         d0 = dispatch_count.snapshot()
         c0 = compile_watch.snapshot()
-        for cid in trainable:
-            coord = coordinates[cid]
-            t0 = time.perf_counter()
-            if fused:
-                # donating decided ONCE at entry and threaded through, so
-                # the copy discipline above cannot diverge from the
-                # donation the programs actually perform
-                new_state, new_score, total, info = coord.sweep_step(
-                    total, scores[cid], states[cid], donate=donating
+        with obs.span("descent.sweep", iteration=it) as sweep_span:
+            for cid in trainable:
+                coord = coordinates[cid]
+                with obs.span(
+                    "descent.coordinate", iteration=it, coordinate=cid
+                ) as coord_span:
+                    if fused:
+                        # donating decided ONCE at entry and threaded
+                        # through, so the copy discipline above cannot
+                        # diverge from the donation the programs perform
+                        new_state, new_score, total, info = coord.sweep_step(
+                            total, scores[cid], states[cid], donate=donating
+                        )
+                    else:
+                        new_state, new_score, total, info = (
+                            Coordinate.sweep_step(
+                                coord, total, scores[cid], states[cid]
+                            )
+                        )
+                    scores[cid] = new_score
+                    states[cid] = new_state
+                    if per_coordinate:
+                        # a read-back is the only honest boundary for per-
+                        # coordinate seconds (block_until_ready can return
+                        # at enqueue over the relay, util/force.py) —
+                        # opt-in: it costs a blocking round trip per
+                        # coordinate per sweep
+                        force(new_score)
+                elapsed = coord_span.duration_s
+                obs.counter("descent.coordinate_steps")
+                tracker.append(
+                    {
+                        "iteration": it,
+                        "coordinate": cid,
+                        "seconds": elapsed,
+                        "info": info,
+                    }
                 )
-            else:
-                new_state, new_score, total, info = Coordinate.sweep_step(
-                    coord, total, scores[cid], states[cid]
+                logger.info(
+                    "CD iter %d coordinate %s %s in %.3fs",
+                    it,
+                    cid,
+                    "trained" if per_coordinate else "enqueued",
+                    elapsed,
                 )
-            scores[cid] = new_score
-            states[cid] = new_state
-            if per_coordinate:
-                # a read-back is the only honest boundary for per-
-                # coordinate seconds (block_until_ready can return at
-                # enqueue over the relay, util/force.py) — opt-in: it
-                # costs a blocking round trip per coordinate per sweep
-                force(new_score)
-            elapsed = time.perf_counter() - t0
-            tracker.append(
-                {
-                    "iteration": it,
-                    "coordinate": cid,
-                    "seconds": elapsed,
-                    "info": info,
-                }
+            barrier_s = 0.0
+            if not per_coordinate:
+                # sync-free steady state: ONE read-back closes the whole
+                # sweep (new_total depends on every coordinate's train +
+                # rescore)
+                with obs.span("descent.barrier", iteration=it) as bar_span:
+                    force(total)
+                barrier_s = bar_span.duration_s
+            cw = compile_watch.delta(c0)
+            dispatches = dispatch_count.snapshot() - d0
+            # the counters ride on the sweep span so the exported trace
+            # carries the dispatch/compile attribution per sweep
+            sweep_span.set(
+                dispatches=dispatches,
+                compiles=cw["backend_compiles"],
+                compile_seconds=cw["backend_compile_s"],
+                barrier_seconds=barrier_s,
+                granularity=tracker_granularity,
             )
-            logger.info(
-                "CD iter %d coordinate %s %s in %.3fs",
-                it,
-                cid,
-                "trained" if per_coordinate else "enqueued",
-                elapsed,
-            )
-        barrier_s = 0.0
-        if not per_coordinate:
-            # sync-free steady state: ONE read-back closes the whole sweep
-            # (new_total depends on every coordinate's train + rescore)
-            t0 = time.perf_counter()
-            force(total)
-            barrier_s = time.perf_counter() - t0
-        cw = compile_watch.delta(c0)
-        tracker.append(
-            {
-                "iteration": it,
-                "sweep_seconds": time.perf_counter() - sweep_t0,
-                "barrier_seconds": barrier_s,
-                "dispatches": dispatch_count.snapshot() - d0,
-                # compile share of this sweep's wall (compile_watch): the
-                # steady state must show ~0 here — a nonzero count past
-                # the first sweep means retrace/recompile leaked into the
-                # hot loop (the class of regression PERF.md r6 pins)
-                "compiles": cw["backend_compiles"],
-                "compile_seconds": cw["backend_compile_s"],
-                "granularity": tracker_granularity,
-            }
-        )
+        sweep_row = {
+            "iteration": it,
+            "sweep_seconds": sweep_span.duration_s,
+            "barrier_seconds": barrier_s,
+            "dispatches": dispatches,
+            # compile share of this sweep's wall (compile_watch): the
+            # steady state must show ~0 here — a nonzero count past
+            # the first sweep means retrace/recompile leaked into the
+            # hot loop (the class of regression PERF.md r6 pins)
+            "compiles": cw["backend_compiles"],
+            "compile_seconds": cw["backend_compile_s"],
+            "granularity": tracker_granularity,
+        }
+        tracker.append(sweep_row)
+        obs.counter("descent.sweeps")
+        obs.histogram("descent.sweep_seconds", sweep_span.duration_s)
+        obs.histogram("descent.barrier_seconds", barrier_s)
+        if sweep_hook is not None:
+            sweep_hook(it, sweep_row)
         if validation_fn is not None:
-            metric = float(validation_fn(states))
+            with obs.span("descent.validation", iteration=it):
+                metric = float(validation_fn(states))
             tracker.append({"iteration": it, "validation": metric})
             logger.info("CD iter %d validation metric %.6f", it, metric)
             if best_metric is None or (
@@ -424,12 +467,16 @@ def run_coordinate_descent(
             # XLA reuses the donated storage. One device-side copy per
             # sweep (only when a callback is installed) restores the
             # retain-what-you-received contract.
-            cb_states = (
-                {cid: _copy_device_leaves(s) for cid, s in states.items()}
-                if donating
-                else states
-            )
-            sweep_callback(it, cb_states, best_states, best_metric)
+            with obs.span("descent.checkpoint", iteration=it):
+                cb_states = (
+                    {
+                        cid: _copy_device_leaves(s)
+                        for cid, s in states.items()
+                    }
+                    if donating
+                    else states
+                )
+                sweep_callback(it, cb_states, best_states, best_metric)
 
     return CoordinateDescentResult(
         states=states,

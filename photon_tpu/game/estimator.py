@@ -22,6 +22,7 @@ from typing import Mapping, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from photon_tpu import obs
 from photon_tpu.evaluation.evaluators import EvaluatorType
 from photon_tpu.game.config import (
     CoordinateConfig,
@@ -120,8 +121,17 @@ class GameEstimator:
     #: compile-bound (cold caches, relay-tunnelled backends, many
     #: coordinates).
     precompile: bool = False
+    #: lifecycle event bus (util/events.EventEmitter). When set, ``fit``
+    #: emits ``setup`` / ``sweep_complete`` / ``training_finish`` /
+    #: ``training_failure`` events with payloads, so LIBRARY callers get
+    #: the same lifecycle stream the CLI drivers always had. Excluded
+    #: from the checkpoint fingerprint (listeners don't change numerics).
+    events: object | None = None
 
     def __post_init__(self):
+        #: per-fit telemetry deltas (wall, dispatches, compiles) for the
+        #: most recent ``fit()`` call — see the fit docstring
+        self.last_fit_stats: dict | None = None
         missing = [c for c in self.update_sequence if c not in self.coordinate_configs]
         if missing:
             raise ValueError(f"update sequence names unknown coordinates: {missing}")
@@ -200,7 +210,8 @@ class GameEstimator:
         re_datasets = {}
         norm = self.normalization_contexts or {}
         if shape_pool is None:
-            shape_pool = self._build_shape_pool(data, initial_model)
+            with obs.span("fit.shape_profile"):
+                shape_pool = self._build_shape_pool(data, initial_model)
         for cid, cfg in self.coordinate_configs.items():
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 coords[cid] = FixedEffectCoordinate.build(
@@ -271,6 +282,17 @@ class GameEstimator:
         """Train one GameModel per λ-grid point, warm-starting across the
         grid (reference fit :304-390 + train :746).
 
+        Telemetry: the whole call runs inside a ``fit`` tracer span
+        (photon_tpu/obs) with nested ``fit.data_build`` /
+        ``fit.precompile`` / ``fit.grid`` → ``descent.sweep`` →
+        ``descent.coordinate`` spans, and per-FIT deltas of the
+        dispatch/compile counters land on the span and in
+        ``self.last_fit_stats`` — deltas, not process totals, so two
+        sequential fits in one process each report their own bill.
+        Lifecycle events (``setup`` / ``sweep_complete`` /
+        ``training_finish`` / ``training_failure``) go to
+        ``self.events`` when an emitter is configured.
+
         ``grid_callback(grid_index, result)`` fires as each grid point
         completes — drivers use it to flush partial progress to disk so a
         crash never loses finished models (SURVEY §5.3: the reference
@@ -291,18 +313,104 @@ class GameEstimator:
         don't pay the profile + DP twice and are guaranteed the fit
         buckets exactly as they priced.
         """
+        from photon_tpu.util import compile_watch, dispatch_count
+
+        emitter = self.events
+        t_fit = time.perf_counter()
+        # per-FIT counter baselines: the process-global compile/dispatch
+        # counters are monotonic (their jax.monitoring listeners register
+        # once per process, compile_watch.install), so every fit reports
+        # its own DELTA — repeated fits never double-count
+        fit_d0 = dispatch_count.snapshot()
+        fit_c0 = compile_watch.snapshot()
+        with obs.span(
+            "fit",
+            task=self.task.name,
+            coordinates=len(self.coordinate_configs),
+            grid_length=self._grid_length(),
+        ) as fit_span:
+            obs.counter("fit.count")
+            if emitter is not None:
+                emitter.emit(
+                    "setup",
+                    coordinates=list(self.coordinate_configs),
+                    update_sequence=list(self.update_sequence),
+                    grid_length=self._grid_length(),
+                    descent_iterations=self.descent_iterations,
+                    num_samples=int(data.num_samples),
+                )
+            try:
+                results = self._fit_impl(
+                    data,
+                    validation_data=validation_data,
+                    initial_model=initial_model,
+                    grid_callback=grid_callback,
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
+                    shape_pool=shape_pool,
+                )
+            except Exception as e:
+                # a failed fit must not leave the PREVIOUS fit's numbers
+                # behind as if they described this call
+                self.last_fit_stats = None
+                if emitter is not None:
+                    emitter.emit(
+                        "training_failure",
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                raise
+            wall_s = time.perf_counter() - t_fit
+            cw = compile_watch.delta(fit_c0)
+            #: per-fit telemetry summary (deltas over this call only)
+            self.last_fit_stats = {
+                "wall_s": round(wall_s, 4),
+                "dispatches": dispatch_count.snapshot() - fit_d0,
+                **cw,
+            }
+            fit_span.set(**self.last_fit_stats)
+            if emitter is not None:
+                evals = [
+                    r.evaluation
+                    for r in results
+                    if r is not None and r.evaluation is not None
+                ]
+                ev = self.validation_evaluator
+                pick = (
+                    max if ev is None or ev.larger_is_better else min
+                )
+                emitter.emit(
+                    "training_finish",
+                    n_grid_points=len(results),
+                    best_evaluation=pick(evals) if evals else None,
+                    wall_time_s=round(wall_s, 4),
+                    dispatches=self.last_fit_stats["dispatches"],
+                )
+            return results
+
+    def _fit_impl(
+        self,
+        data: GameData,
+        *,
+        validation_data,
+        initial_model,
+        grid_callback,
+        checkpoint_dir,
+        checkpoint_every,
+        shape_pool,
+    ) -> list[GameTrainingResult]:
         if self.ignore_threshold_for_new_models and initial_model is None:
             raise ValueError(
                 "ignore_threshold_for_new_models requires an initial model "
                 "(reference GameEstimator validation :226)"
             )
-        if self.mesh is not None:
-            from photon_tpu.game.data import pad_game_data
+        with obs.span("fit.data_build", num_samples=int(data.num_samples)):
+            if self.mesh is not None:
+                from photon_tpu.game.data import pad_game_data
 
-            data = pad_game_data(data, int(self.mesh.devices.size))
-        coordinates, re_datasets = self._build_coordinates(
-            data, initial_model, shape_pool=shape_pool
-        )
+                data = pad_game_data(data, int(self.mesh.devices.size))
+            coordinates, re_datasets = self._build_coordinates(
+                data, initial_model, shape_pool=shape_pool
+            )
 
         from photon_tpu.util import compile_watch
 
@@ -310,15 +418,21 @@ class GameEstimator:
         if self.precompile:
             from photon_tpu.game.descent import precompile_coordinates
 
-            precompile_report = precompile_coordinates(
-                coordinates, locked=self.locked_coordinates
-            )
+            with obs.span("fit.precompile") as pre_span:
+                precompile_report = precompile_coordinates(
+                    coordinates, locked=self.locked_coordinates
+                )
+                pre_span.set(
+                    n_programs=precompile_report["n_programs"],
+                    cache_hits=precompile_report["cache_hits"],
+                )
 
         init_states = None
         if initial_model is not None:
-            init_states = self._states_from_model(
-                initial_model, coordinates, re_datasets
-            )
+            with obs.span("fit.warm_start"):
+                init_states = self._states_from_model(
+                    initial_model, coordinates, re_datasets
+                )
 
         validation_fn = None
         if validation_data is not None and self.validation_evaluator is not None:
@@ -327,12 +441,13 @@ class GameEstimator:
             # per sweep (r2 weak #6)
             from photon_tpu.game.validation import DeviceValidationScorer
 
-            scorer = DeviceValidationScorer.build(
-                validation_data,
-                coordinates,
-                self.validation_evaluator,
-                self.dtype,
-            )
+            with obs.span("fit.validation_build"):
+                scorer = DeviceValidationScorer.build(
+                    validation_data,
+                    coordinates,
+                    self.validation_evaluator,
+                    self.dtype,
+                )
             validation_fn = scorer.evaluate
 
         checkpointer = None
@@ -421,7 +536,24 @@ class GameEstimator:
                     )
                 )
 
-            with compile_watch.watch() as grid_compiles:
+            sweep_hook = None
+            if self.events is not None:
+                # stateless per-sweep notification (no donation copies,
+                # game/descent.py): library listeners see sweep progress
+                sweep_hook = (
+                    lambda it, row, _gi=gi: self.events.emit(
+                        "sweep_complete",
+                        grid_index=_gi,
+                        iteration=it,
+                        sweep_seconds=row["sweep_seconds"],
+                        dispatches=row["dispatches"],
+                        compiles=row["compiles"],
+                    )
+                )
+
+            with compile_watch.watch() as grid_compiles, obs.span(
+                "fit.grid", grid_index=gi
+            ):
                 cd = run_coordinate_descent(
                     coords_gi,
                     self.update_sequence,
@@ -437,6 +569,7 @@ class GameEstimator:
                     start_iteration=start_iteration,
                     initial_best=initial_best,
                     sweep_callback=sweep_callback,
+                    sweep_hook=sweep_hook,
                     tracker_granularity=self.tracker_granularity,
                 )
             final_states = (

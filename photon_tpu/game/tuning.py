@@ -97,6 +97,10 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameTrainingResult]):
             # tuning refits train from scratch (no initial model), so the
             # warm-start-only threshold bypass must not carry over
             ignore_threshold_for_new_models=False,
+            # internal exploratory fits: don't re-emit the lifecycle
+            # setup/training_finish events once per tuning candidate —
+            # listeners on the parent estimator's bus see one fit
+            events=None,
         )
         results = estimator.fit(
             self.train_data, validation_data=self.validation_data

@@ -333,8 +333,11 @@ def write_avro_file(
 
 def iter_avro_file(path: str | os.PathLike) -> Iterator[dict]:
     """Stream records from an Avro object container file."""
+    from photon_tpu import obs
+
     with open(path, "rb") as f:
         data = f.read()
+    obs.counter("io.bytes", len(data))
     if data[:4] != MAGIC:
         raise ValueError(f"{path}: not an Avro object container file")
     r = _Reader(data)

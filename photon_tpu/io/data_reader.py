@@ -15,6 +15,7 @@ from typing import Iterable, Mapping, Sequence
 
 import numpy as np
 
+from photon_tpu import obs
 from photon_tpu.data.index_map import (
     DefaultIndexMap,
     INTERCEPT_KEY,
@@ -101,25 +102,56 @@ class AvroDataReader:
         The C++ columnar fast path (io/native_avro.py) handles the common
         schemas; anything it can't express falls back to the record-dict
         decode below — both produce identical GameData.
+
+        Telemetry: the whole read runs in an ``io.read`` span (with the
+        decode loop split out as ``io.decode``), recording records read,
+        decoder used, and shard count; ``io.records`` / ``io.bytes``
+        counters accumulate volume.
         """
         if isinstance(paths, (str, bytes)):
             paths = [paths]
-        if os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
-            try:
-                from photon_tpu.io.native_avro import read_game_data_native
+        with obs.span("io.read", paths=len(paths)) as read_span:
+            return self._read(paths, shard_configs, id_tags, read_span)
 
-                native = read_game_data_native(
-                    list(paths), shard_configs, id_tags, dict(self.index_maps)
-                )
-            except Exception:  # any native-path surprise → Python decode
-                native = None
+    def _read(self, paths, shard_configs, id_tags, read_span):
+        if os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
+            with obs.span("io.decode", decoder="native") as native_span:
+                try:
+                    from photon_tpu.io.native_avro import (
+                        read_game_data_native,
+                    )
+
+                    native = read_game_data_native(
+                        list(paths),
+                        shard_configs,
+                        id_tags,
+                        dict(self.index_maps),
+                    )
+                except Exception:  # any native-path surprise → Python decode
+                    native = None
+                if native is None:
+                    # the span is already recorded — mark it so a profile
+                    # reader doesn't mistake a failed/unavailable native
+                    # attempt for the decode that actually produced data
+                    native_span.set(ok=False)
             if native is not None:
                 data, maps = native
                 self.index_maps.update(maps)
+                read_span.set(
+                    records=int(data.num_samples),
+                    decoder="native",
+                    shards=len(shard_configs),
+                )
+                obs.counter("io.records", int(data.num_samples))
                 return data
-        records = []
-        for p in paths:
-            records.extend(read_avro_dir(p))
+        with obs.span("io.decode", decoder="python"):
+            records = []
+            for p in paths:
+                records.extend(read_avro_dir(p))
+        read_span.set(
+            records=len(records), decoder="python", shards=len(shard_configs)
+        )
+        obs.counter("io.records", len(records))
 
         if not set(shard_configs) <= set(self.index_maps):
             generated = self.generate_index_maps(records, shard_configs)

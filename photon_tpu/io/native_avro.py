@@ -459,6 +459,14 @@ def read_game_data_native(
             return None
         decoded.append(df)
 
+    # count volume only once the whole set decoded natively — a mid-loop
+    # fallback to the Python reader would otherwise double-count the
+    # already-decoded files when iter_avro_file re-reads them
+    from photon_tpu import obs
+
+    for fp in files:
+        obs.counter("io.bytes", os.path.getsize(fp))
+
     labels = np.concatenate([d.labels for d in decoded])
     offsets = np.concatenate([d.offsets for d in decoded])
     weights = np.concatenate([d.weights for d in decoded])

@@ -25,7 +25,7 @@ from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel, model_for_task
 from photon_tpu.ops.normalization import NormalizationContext
 from photon_tpu.util.force import force
-from photon_tpu.optimize.common import OptimizeResult
+from photon_tpu.optimize.common import OptimizeResult, record_optimize_metrics
 from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
 from photon_tpu.types import Array, LabeledBatch, SparseBatch
 
@@ -116,6 +116,9 @@ def train_glm_grid(
         result = problem.solve(solve_batch, w)
         force(result.x)  # read-back: block_until_ready can return at enqueue
         wall = time.perf_counter() - t0
+        # inner-loop work counters → telemetry registry (eager path:
+        # results are concrete here)
+        record_optimize_metrics(result)
 
         variances_t = problem.variances(batch, result.x)
         w_model = normalization.model_to_original_space(result.x)

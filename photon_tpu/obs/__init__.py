@@ -1,0 +1,139 @@
+"""Runtime telemetry spine: span tracing + metrics + exporters.
+
+This package unifies the repo's observability fragments (``util/timed``,
+``util/profiler``, ``util/events``, ``util/compile_watch``,
+``util/dispatch_count``, the descent tracker rows) behind ONE runtime
+layer with three parts:
+
+- :mod:`photon_tpu.obs.tracer` — a thread-safe span :class:`Tracer`
+  (monotonic clocks, nestable spans, a near-zero-overhead no-op when
+  disabled). Each recorded span also enters a
+  ``jax.profiler.TraceAnnotation`` so host spans line up with device
+  traces captured by the jax profiler.
+- :mod:`photon_tpu.obs.metrics` — a :class:`MetricsRegistry` of
+  counters / gauges / histograms with a flat ``snapshot()`` dict.
+- :mod:`photon_tpu.obs.export` — Chrome trace-event JSON (opens in
+  Perfetto / ``chrome://tracing``), a JSONL run manifest, and a
+  human-readable per-phase summary table.
+
+The module-level functions operate on ONE process-global pipeline
+(default tracer + default registry) gated by a single enable switch, so
+instrumentation sites stay one-liners::
+
+    from photon_tpu import obs
+
+    obs.enable()
+    with obs.span("fit", grid=3):
+        ...
+    obs.write_chrome_trace("run.trace.json")
+
+Telemetry is DISABLED by default (set ``PHOTON_OBS=1`` to enable at
+import, or call :func:`enable`). Disabled spans still measure wall time
+(two monotonic clock reads — descent derives its tracker rows from
+them) but record nothing, take no locks, and never touch the device:
+enabling or disabling telemetry cannot change the dispatch or read-back
+profile of a run.
+"""
+from __future__ import annotations
+
+import os
+
+from photon_tpu.obs.export import (
+    chrome_trace,
+    export_artifacts,
+    phase_summary,
+    summary_table,
+    write_chrome_trace,
+    write_metrics,
+    write_run_manifest,
+)
+from photon_tpu.obs.metrics import MetricsRegistry
+from photon_tpu.obs.tracer import Span, Tracer
+
+__all__ = [
+    "MetricsRegistry",
+    "Span",
+    "Tracer",
+    "chrome_trace",
+    "counter",
+    "disable",
+    "enable",
+    "enabled",
+    "export_artifacts",
+    "gauge",
+    "get_registry",
+    "get_tracer",
+    "histogram",
+    "instant",
+    "phase_summary",
+    "reset",
+    "span",
+    "summary_table",
+    "write_chrome_trace",
+    "write_metrics",
+    "write_run_manifest",
+]
+
+_tracer = Tracer(enabled=os.environ.get("PHOTON_OBS", "") == "1")
+_registry = MetricsRegistry()
+
+
+def get_tracer() -> Tracer:
+    """The process-global default tracer."""
+    return _tracer
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global default metrics registry."""
+    return _registry
+
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+def enable() -> None:
+    """Turn the global telemetry pipeline on (tracer + bridge counters)."""
+    _tracer.enabled = True
+
+
+def disable() -> None:
+    _tracer.enabled = False
+
+
+def reset() -> None:
+    """Drop every recorded span and zero the registry (artifact boundary:
+    bench calls this per config so each artifact holds one run)."""
+    _tracer.clear()
+    _registry.clear()
+
+
+def span(name: str, cat: str = "phase", **args) -> Span:
+    """A span on the default tracer — always measures, records only when
+    telemetry is enabled."""
+    return _tracer.span(name, cat=cat, **args)
+
+
+def instant(name: str, cat: str = "event", **args) -> None:
+    """Record an instant (zero-duration) event when enabled."""
+    _tracer.instant(name, cat=cat, **args)
+
+
+def counter(name: str, value: float = 1.0) -> None:
+    """Bump a counter on the default registry (no-op while disabled, so
+    bridge call sites cost one attribute check on the hot path)."""
+    if _tracer.enabled:
+        _registry.counter(name, value)
+
+
+def gauge(name: str, value: float) -> None:
+    """Set a gauge on the default registry (no-op while disabled)."""
+    if _tracer.enabled:
+        _registry.gauge(name, value)
+
+
+def histogram(name: str, value: float) -> None:
+    """Observe a histogram sample on the default registry (no-op while
+    disabled)."""
+    if _tracer.enabled:
+        _registry.histogram(name, value)

@@ -1,0 +1,225 @@
+"""Exporters: Chrome trace-event JSON, JSONL run manifest, summary table.
+
+The Chrome trace format (the ``traceEvents`` array of ``ph: "X"``
+complete events) is what Perfetto (https://ui.perfetto.dev) and
+``chrome://tracing`` open directly — ``ts``/``dur`` are microseconds,
+events on the same ``pid``/``tid`` nest by time containment. Instant
+events export as ``ph: "i"``. One metadata event names the process.
+
+The JSONL run manifest is the machine-readable record of a run: a
+header line (schema, pid, wall-clock anchor, caller metadata), one line
+per span, and a final metrics line — greppable, streamable, diffable.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, TextIO
+
+MANIFEST_SCHEMA = 1
+
+
+def _resolve(tracer, registry):
+    """Default to the process-global pipeline without importing it at
+    module load (obs/__init__ imports this module)."""
+    if tracer is None or registry is None:
+        from photon_tpu import obs
+
+        tracer = tracer if tracer is not None else obs.get_tracer()
+        registry = registry if registry is not None else obs.get_registry()
+    return tracer, registry
+
+
+def _json_safe(v: Any) -> Any:
+    """Coerce span args to JSON-encodable values (device scalars, numpy
+    ints, paths — exporters must never throw on an attribute)."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if hasattr(v, "tolist"):  # numpy / jax arrays and scalars
+        try:
+            return _json_safe(v.tolist())
+        except Exception:
+            pass
+    try:
+        return float(v)
+    except Exception:
+        return str(v)
+
+
+def chrome_trace(tracer=None, registry=None, meta: dict | None = None) -> dict:
+    """The run as a Chrome trace-event JSON object."""
+    tracer, registry = _resolve(tracer, registry)
+    events: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": tracer.pid,
+            "tid": 0,
+            "args": {"name": "photon-tpu"},
+        }
+    ]
+    for rec in tracer.spans():
+        ts_us = (rec.t0_ns - tracer.epoch_ns) / 1e3
+        ev = {
+            "name": rec.name,
+            "cat": rec.cat,
+            "pid": tracer.pid,
+            "tid": rec.tid,
+            "ts": ts_us,
+            "args": _json_safe(
+                {**rec.args, "span_id": rec.span_id, "parent_id": rec.parent_id}
+            ),
+        }
+        if rec.instant:
+            ev["ph"] = "i"
+            ev["s"] = "t"  # thread-scoped instant marker
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = rec.dur_ns / 1e3
+        events.append(ev)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": _json_safe(
+            {
+                "epoch_wall_s": tracer.epoch_wall_s,
+                "metrics": registry.snapshot(),
+                **(meta or {}),
+            }
+        ),
+    }
+
+
+def write_chrome_trace(path, tracer=None, registry=None, meta=None) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(tracer, registry, meta), f)
+    return str(path)
+
+
+def write_metrics(path, registry=None, meta: dict | None = None) -> str:
+    """Registry snapshot (plus caller metadata) as one JSON document —
+    the file ``scripts/check_obs_regression.py`` bands."""
+    _, registry = _resolve(None, registry)
+    with open(path, "w") as f:
+        json.dump(
+            _json_safe({**(meta or {}), "metrics": registry.snapshot()}),
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+    return str(path)
+
+
+def write_run_manifest(path, tracer=None, registry=None, meta=None) -> str:
+    """JSONL manifest: header, one line per span, trailing metrics line."""
+    tracer, registry = _resolve(tracer, registry)
+
+    def _dump(f: TextIO, obj: dict) -> None:
+        f.write(json.dumps(_json_safe(obj)) + "\n")
+
+    with open(path, "w") as f:
+        _dump(
+            f,
+            {
+                "kind": "header",
+                "schema": MANIFEST_SCHEMA,
+                "pid": tracer.pid,
+                "epoch_wall_s": tracer.epoch_wall_s,
+                **(meta or {}),
+            },
+        )
+        for rec in tracer.spans():
+            _dump(
+                f,
+                {
+                    "kind": "instant" if rec.instant else "span",
+                    "name": rec.name,
+                    "cat": rec.cat,
+                    "t_s": round((rec.t0_ns - tracer.epoch_ns) / 1e9, 6),
+                    "dur_s": round(rec.dur_ns / 1e9, 6),
+                    "tid": rec.tid,
+                    "span_id": rec.span_id,
+                    "parent_id": rec.parent_id,
+                    "args": rec.args,
+                },
+            )
+        _dump(f, {"kind": "metrics", **registry.snapshot()})
+    return str(path)
+
+
+def export_artifacts(
+    directory,
+    prefix: str = "",
+    tracer=None,
+    registry=None,
+    meta: dict | None = None,
+) -> dict:
+    """Write the full artifact set under ``directory`` — Chrome trace,
+    metrics snapshot, JSONL manifest, and the per-phase summary table —
+    and return ``{"trace", "metrics", "manifest", "summary"}`` paths.
+    ``prefix`` namespaces the filenames (bench writes one set per config
+    into a shared directory); the CLI drivers and bench both export
+    through here so the artifact layout cannot drift between them."""
+    os.makedirs(directory, exist_ok=True)
+
+    def _path(name: str) -> str:
+        return os.path.join(str(directory), prefix + name)
+
+    paths = {
+        "trace": write_chrome_trace(
+            _path("trace.json"), tracer, registry, meta
+        ),
+        "metrics": write_metrics(_path("metrics.json"), registry, meta),
+        "manifest": write_run_manifest(
+            _path("manifest.jsonl"), tracer, registry, meta
+        ),
+    }
+    summary_path = _path("summary.txt")
+    with open(summary_path, "w") as f:
+        f.write(summary_table(tracer) + "\n")
+    paths["summary"] = summary_path
+    return paths
+
+
+def phase_summary(tracer=None) -> dict:
+    """Aggregate spans by name: ``{name: {count, total_s, mean_s,
+    max_s}}`` — the per-phase wall split bench rows carry."""
+    tracer, _ = _resolve(tracer, None)
+    out: dict[str, dict] = {}
+    for rec in tracer.spans():
+        if rec.instant:
+            continue
+        agg = out.setdefault(
+            rec.name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+        )
+        agg["count"] += 1
+        agg["total_s"] += rec.dur_ns / 1e9
+        agg["max_s"] = max(agg["max_s"], rec.dur_ns / 1e9)
+    for agg in out.values():
+        agg["total_s"] = round(agg["total_s"], 6)
+        agg["max_s"] = round(agg["max_s"], 6)
+        agg["mean_s"] = round(agg["total_s"] / agg["count"], 6)
+    return out
+
+
+def summary_table(tracer=None) -> str:
+    """Human-readable per-phase table, widest total first."""
+    phases = phase_summary(tracer)
+    if not phases:
+        return "(no spans recorded)"
+    rows = sorted(phases.items(), key=lambda kv: -kv[1]["total_s"])
+    width = max(len(name) for name, _ in rows)
+    lines = [
+        f"{'phase':<{width}} {'count':>6} {'total_s':>10} {'mean_s':>10} "
+        f"{'max_s':>10}"
+    ]
+    for name, agg in rows:
+        lines.append(
+            f"{name:<{width}} {agg['count']:>6} {agg['total_s']:>10.4f} "
+            f"{agg['mean_s']:>10.4f} {agg['max_s']:>10.4f}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,89 @@
+"""Metrics registry: counters, gauges, histograms.
+
+One flat namespace of dotted metric names (``descent.dispatches``,
+``io.records``, ``compile.backend_compiles`` — taxonomy in
+docs/DESIGN.md §Observability). Three instrument kinds:
+
+- **counter** — monotonic accumulator (int or float increments);
+- **gauge** — last-write-wins scalar;
+- **histogram** — streaming count/sum/min/max of observed samples (no
+  sample buffer: bench sweeps observe thousands of values, and the
+  moments are what the regression gate bands).
+
+``snapshot()`` returns plain JSON-serializable dicts; ``delta()`` diffs
+two snapshots fieldwise so callers can attribute counters to a region
+the way ``compile_watch`` deltas do.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class MetricsRegistry:
+    """Thread-safe metrics container."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, dict] = {}
+
+    # -- instruments -------------------------------------------------------
+
+    def counter(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def histogram(self, name: str, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = {
+                    "count": 0,
+                    "sum": 0.0,
+                    "min": value,
+                    "max": value,
+                }
+            h["count"] += 1
+            h["sum"] += value
+            h["min"] = min(h["min"], value)
+            h["max"] = max(h["max"], value)
+
+    # -- reading -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """``{"counters": {...}, "gauges": {...}, "histograms": {...}}`` —
+        plain data, safe to json.dumps."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: dict(v) for k, v in self._hists.items()},
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    @staticmethod
+    def delta(before: dict, after: dict) -> dict:
+        """Counter-wise ``after − before`` (gauges/histograms report the
+        ``after`` state: they are not monotonic)."""
+        b = before.get("counters", {})
+        a = after.get("counters", {})
+        return {
+            "counters": {
+                k: a.get(k, 0) - b.get(k, 0) for k in set(a) | set(b)
+            },
+            "gauges": dict(after.get("gauges", {})),
+            "histograms": {
+                k: dict(v) for k, v in after.get("histograms", {}).items()
+            },
+        }

@@ -18,6 +18,7 @@ import dataclasses
 import enum
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -138,6 +139,28 @@ class OptimizeResult(NamedTuple):
         for i in range(min(it + 1, lh.shape[0])):
             lines.append(f"{i:>5} {lh[i]:>16.8g} {gh[i]:>12.4g}")
         return "\n".join(lines)
+
+
+def record_optimize_metrics(
+    result: OptimizeResult, prefix: str = "optimize"
+) -> None:
+    """Feed an OptimizeResult's exact work counters into the telemetry
+    registry (``optimize.iterations`` / ``.n_evals`` / ``.n_hvp`` /
+    ``.n_feature_passes`` — the line-search/inner-loop accounting the
+    spans cannot see because the loops run inside one XLA program).
+    No-op while telemetry is disabled, and safe on traced results: a
+    counter that is not concrete (called under jit) records nothing
+    rather than tracing a read-back into the program."""
+    from photon_tpu import obs
+
+    if not obs.enabled():
+        return
+    for name in ("iterations", "n_evals", "n_hvp", "n_feature_passes"):
+        v = getattr(result, name)
+        try:
+            obs.counter(f"{prefix}.{name}", int(v))
+        except (TypeError, jax.errors.TracerArrayConversionError):
+            return  # traced → whole result is traced; nothing to record
 
 
 def project_to_box(

@@ -21,6 +21,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from photon_tpu import obs
 from photon_tpu.data.sampling import build_down_sampler
 from photon_tpu.ops.losses import loss_for_task
 from photon_tpu.ops.normalization import NormalizationContext
@@ -181,7 +182,34 @@ class GLMProblem:
         donation-safe fused-sweep entry: callers hand over the pristine
         batch plus the residual instead of pre-building a mutated batch
         pytree, so the offset add fuses into the objective's margin pass
-        and the only [N] temporary is the one XLA schedules."""
+        and the only [N] temporary is the one XLA schedules.
+
+        Telemetry: runs in an ``optimize.solve`` span. Called eagerly
+        (legacy GLM grid) the span is the solve wall; called under a jit
+        trace (GAME fused sweeps) it records the TRACE wall once per
+        compile and nothing in the steady state — either way no device
+        work is added. Per-iteration counters (``n_evals``, line-search
+        trials) live in the returned OptimizeResult; eager callers feed
+        them to the registry via :func:`record_optimize_metrics`."""
+        with obs.span(
+            "optimize.solve",
+            cat="solve",
+            optimizer=self.config.optimizer.name,
+            task=self.config.task.name,
+        ):
+            obs.counter("optimize.solves")
+            return self._solve(
+                batch, w0, reg_weight, extra_offsets=extra_offsets
+            )
+
+    def _solve(
+        self,
+        batch: LabeledBatch,
+        w0: Array,
+        reg_weight=None,
+        *,
+        extra_offsets: Array | None = None,
+    ) -> OptimizeResult:
         if extra_offsets is not None:
             batch = batch._replace(offsets=batch.offsets + extra_offsets)
         cfg = self.config.optimizer_config

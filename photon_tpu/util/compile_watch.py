@@ -70,6 +70,14 @@ def _bump(count_key, secs_key, secs):
                 acc[count_key] += 1
             if secs_key is not None:
                 acc[secs_key] += secs
+    # telemetry-spine mirror (photon_tpu/obs): dotted compile.* counters
+    # in the global metrics registry — no-ops while telemetry is disabled
+    from photon_tpu import obs
+
+    if count_key is not None:
+        obs.counter(f"compile.{count_key}")
+    if secs_key is not None:
+        obs.counter(f"compile.{secs_key}", secs)
 
 
 def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
@@ -101,6 +109,15 @@ def install() -> bool:
     with _LOCK:
         _INSTALLED = True
     return True
+
+
+def installed() -> bool:
+    """True when the monitoring listeners are registered. Exactly one
+    registration ever happens per process — repeated ``install()`` calls
+    (every ``fit()``, every ``watch()``) are no-ops, so per-region deltas
+    stay single-counted no matter how many fits share the process."""
+    with _LOCK:
+        return _INSTALLED
 
 
 def snapshot() -> dict:

@@ -17,13 +17,17 @@ regression test (tests/test_fused_sweep.py) independently verifies the
 """
 from __future__ import annotations
 
+from photon_tpu import obs
+
 _count = 0
 
 
 def record(n: int = 1) -> None:
-    """Count ``n`` compiled-program launches."""
+    """Count ``n`` compiled-program launches (mirrored as the
+    ``descent.dispatches`` telemetry counter when obs is enabled)."""
     global _count
     _count += n
+    obs.counter("descent.dispatches", n)
 
 
 def snapshot() -> int:

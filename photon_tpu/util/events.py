@@ -1,11 +1,18 @@
 """Training lifecycle event bus (reference photon-client
 event/EventEmitter.scala:24-73 — pluggable listeners notified of driver
-lifecycle events such as setup, training start/finish, failure)."""
+lifecycle events such as setup, training start/finish, failure).
+
+Bridged into the telemetry spine: every emitted event is mirrored as an
+instant event on the global :mod:`photon_tpu.obs` tracer (cat
+``"lifecycle"``), so lifecycle markers appear on the Perfetto timeline
+between the phase spans. A disabled tracer makes the mirror a no-op."""
 from __future__ import annotations
 
 import dataclasses
 import logging
 from typing import Any, Callable
+
+from photon_tpu import obs
 
 logger = logging.getLogger("photon_tpu")
 
@@ -54,6 +61,12 @@ class EventEmitter:
 
     def emit(self, name: str, **payload: Any) -> None:
         event = Event(name=name, payload=payload)
+        try:
+            obs.instant(name, cat="lifecycle", **payload)
+        except TypeError:
+            # a payload key collides with instant()'s own kwargs (e.g.
+            # ``cat``): the mirror must never break the event bus
+            obs.instant(name, cat="lifecycle", payload=dict(payload))
         for listener in self._listeners:
             try:
                 listener.on_event(event)

@@ -1,11 +1,18 @@
 """Block timing (reference photon-lib util/Timed.scala, used around every
-pipeline phase, e.g. GameTrainingDriver.scala:346-466)."""
+pipeline phase, e.g. GameTrainingDriver.scala:346-466).
+
+Bridged into the telemetry spine: every ``Timed`` block is also a span
+on the global :mod:`photon_tpu.obs` tracer (cat ``"timed"``), so the
+CLI drivers' existing phase timers land in exported run profiles with
+no driver changes. When telemetry is disabled the span is a no-op."""
 from __future__ import annotations
 
 import functools
 import logging
 import time
 from typing import Callable, TypeVar
+
+from photon_tpu import obs
 
 logger = logging.getLogger("photon_tpu")
 
@@ -27,11 +34,13 @@ class Timed:
         self.elapsed_s: float | None = None
 
     def __enter__(self) -> "Timed":
+        self._span = obs.span(self.name, cat="timed").__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.elapsed_s = time.perf_counter() - self._t0
+        self._span.__exit__(exc_type, exc, tb)
         status = "failed after" if exc_type else "took"
         self.log.info("%s %s %.3f s", self.name, status, self.elapsed_s)
 

@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Telemetry metric-shape regression gate.
+
+Runs one small, deterministic GAME fit on CPU with the obs spine enabled
+(the CANONICAL fit — fixed seeds, fixed shapes), snapshots the telemetry
+it produces (registry counters, span census, tracker-row fields), and
+diffs that snapshot against the committed baseline
+``scripts/obs_baseline.json`` with per-metric tolerance bands:
+
+- **structural counters** (``descent.sweeps``, ``descent.dispatches``,
+  span counts for the fit/descent taxonomy, tracker-row field lists)
+  must match EXACTLY — these encode the one-program-per-coordinate
+  dispatch contract and the span taxonomy, and any drift is a real
+  behavior or shape change someone must sign off on (by regenerating
+  the baseline with ``--write-baseline``);
+- **compiler-coupled counters** (``compile.*`` counts,
+  ``optimize.solve`` trace spans) get a relative band — they move with
+  jax version skew, not with our code;
+- **wall-clock metrics** (anything ``*_s`` / ``*_seconds``) are checked
+  for PRESENCE only — machines differ, shapes must not.
+
+Exit status: 0 = no drift, 2 = violations (printed one per line).
+
+Usage:
+    python scripts/check_obs_regression.py            # run fit + check
+    python scripts/check_obs_regression.py --write-baseline
+    python scripts/check_obs_regression.py --snapshot snap.json
+    python scripts/check_obs_regression.py --write-snapshot snap.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, _REPO_ROOT)
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "obs_baseline.json")
+SNAPSHOT_SCHEMA = 1
+
+#: span names whose per-run counts are structural (exact): the fit →
+#: data build → precompile → sweep → coordinate taxonomy itself
+STRUCTURAL_SPANS = (
+    "fit",
+    "fit.data_build",
+    "fit.shape_profile",
+    "fit.grid",
+    "descent.initial_score",
+    "descent.sweep",
+    "descent.coordinate",
+    "descent.barrier",
+)
+
+
+def build_canonical_fit():
+    """The deterministic smoke fit every snapshot measures: FE + one
+    Zipf-ish per-user RE, fixed seeds, 3 sweeps, CPU-sized."""
+    import numpy as np
+
+    from photon_tpu.game.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.game.data import CSRMatrix, GameData
+    from photon_tpu.game.estimator import GameEstimator
+    from photon_tpu.optimize.common import OptimizerConfig
+    from photon_tpu.optimize.problem import (
+        GLMProblemConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(7)
+    n, users, d_fe, d_re = 400, 32, 6, 4
+    ids = rng.integers(0, users, size=n)
+    x = rng.normal(size=(n, d_fe))
+    xr = rng.normal(size=(n, d_re))
+    y = x @ rng.normal(size=d_fe) * 0.3 + rng.normal(size=n) * 0.1
+    data = GameData.build(
+        labels=y,
+        feature_shards={
+            "g": CSRMatrix.from_dense(x),
+            "u": CSRMatrix.from_dense(xr),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+    opt = GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        optimizer_config=OptimizerConfig(max_iterations=5),
+    )
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="g",
+                optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="u",
+                optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["fixed", "user"],
+        descent_iterations=3,
+        seed=7,
+    )
+    return est, data
+
+
+def collect_snapshot() -> dict:
+    """Run the canonical fit under a clean telemetry pipeline and return
+    the metric-shape snapshot."""
+    import jax
+
+    from photon_tpu import obs
+    from photon_tpu.obs import phase_summary
+
+    est, data = build_canonical_fit()
+    obs.reset()
+    obs.enable()
+    # the canonical fit must compile cold every time: a warm persistent
+    # XLA cache (tests/conftest.py enables one) would swallow backend
+    # compiles and make the compile.* counters measure cache state
+    # instead of code shape
+    cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        results = est.fit(data)
+    finally:
+        obs.disable()
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    snap = obs.get_registry().snapshot()
+    # cache hit/miss counts also track environment cache state — they are
+    # real telemetry but not part of the banded metric SHAPE
+    metrics: dict = {
+        k: v
+        for k, v in snap["counters"].items()
+        if not k.startswith("compile.cache_")
+    }
+    for name, h in snap["histograms"].items():
+        metrics[f"{name}:count"] = h["count"]
+    for name, agg in phase_summary().items():
+        metrics[f"span:{name}"] = agg["count"]
+    tracker = results[0].tracker
+    coord_rows = [r for r in tracker if "coordinate" in r]
+    sweep_rows = [r for r in tracker if "sweep_seconds" in r]
+    return {
+        "schema": SNAPSHOT_SCHEMA,
+        "metrics": metrics,
+        "tracker_fields": {
+            "coordinate_row": sorted(coord_rows[0]),
+            "sweep_row": sorted(sweep_rows[0]),
+        },
+    }
+
+
+def _tolerance_for(name: str, value) -> dict:
+    """Default banding policy, baked into the baseline at --write-baseline
+    time so the committed file is self-describing."""
+    if (
+        name.endswith("_s")
+        or name.endswith("_seconds")
+        or name.endswith(":sum")
+    ):
+        return {"presence_only": True}
+    if name.startswith("compile.") or name in (
+        "span:optimize.solve",
+        "optimize.solves",
+    ):
+        # compiler-coupled: moves with jax internals, not with our code
+        return {"rel_tol": 0.5, "min_slack": 2}
+    if name.startswith("span:") and name[5:] not in STRUCTURAL_SPANS:
+        return {"rel_tol": 0.5, "min_slack": 2}
+    return {"abs_tol": 0}
+
+
+def make_baseline(snapshot: dict) -> dict:
+    return {
+        "schema": SNAPSHOT_SCHEMA,
+        "metrics": {
+            name: {"value": value, **_tolerance_for(name, value)}
+            for name, value in sorted(snapshot["metrics"].items())
+        },
+        "tracker_fields": snapshot["tracker_fields"],
+    }
+
+
+def compare(snapshot: dict, baseline: dict) -> list[str]:
+    """Violations between a snapshot and the committed baseline (empty
+    list = no drift)."""
+    violations: list[str] = []
+    got = snapshot["metrics"]
+    expected = baseline["metrics"]
+    for name, band in expected.items():
+        if name not in got:
+            violations.append(f"missing metric: {name}")
+            continue
+        if band.get("presence_only"):
+            continue
+        value, want = got[name], band["value"]
+        if "abs_tol" in band:
+            if abs(value - want) > band["abs_tol"]:
+                violations.append(
+                    f"{name}: {value} outside {want}±{band['abs_tol']}"
+                )
+        elif "rel_tol" in band:
+            slack = max(
+                band["rel_tol"] * abs(want), band.get("min_slack", 0)
+            )
+            if abs(value - want) > slack:
+                violations.append(
+                    f"{name}: {value} outside {want}±{slack:g}"
+                )
+    for name in got:
+        if name not in expected:
+            violations.append(f"new metric not in baseline: {name}")
+    for row, fields in baseline.get("tracker_fields", {}).items():
+        if snapshot.get("tracker_fields", {}).get(row) != fields:
+            violations.append(
+                f"tracker {row} fields drifted: "
+                f"{snapshot.get('tracker_fields', {}).get(row)} != {fields}"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument(
+        "--snapshot",
+        default=None,
+        help="check this snapshot file instead of running the canonical fit",
+    )
+    ap.add_argument("--write-snapshot", default=None)
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="regenerate the committed baseline from a fresh snapshot",
+    )
+    args = ap.parse_args(argv)
+
+    if args.snapshot:
+        with open(args.snapshot) as f:
+            snapshot = json.load(f)
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        snapshot = collect_snapshot()
+    if args.write_snapshot:
+        with open(args.write_snapshot, "w") as f:
+            json.dump(snapshot, f, indent=2, sort_keys=True)
+        print(f"wrote snapshot to {args.write_snapshot}")
+    if args.write_baseline:
+        with open(args.baseline, "w") as f:
+            json.dump(make_baseline(snapshot), f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote baseline to {args.baseline}")
+        return 0
+
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    violations = compare(snapshot, baseline)
+    if violations:
+        print(f"OBS REGRESSION: {len(violations)} violation(s)")
+        for v in violations:
+            print(f"  - {v}")
+        return 2
+    print(f"obs metrics match baseline ({len(baseline['metrics'])} bands)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,20 @@ def test_timed_context_and_decorator(caplog):
         assert any("fn-y" in r.message for r in caplog.records)
 
 
+def test_timed_records_elapsed_on_exception(caplog):
+    """A failing block still gets its wall measured and logged as
+    'failed after' — phase timing must survive the error path."""
+    with caplog.at_level(logging.INFO, logger="photon_tpu"):
+        with pytest.raises(RuntimeError):
+            with Timed("phase-boom") as t:
+                raise RuntimeError("mid-phase")
+    assert t.elapsed_s is not None and t.elapsed_s >= 0
+    assert any(
+        "phase-boom" in r.message and "failed after" in r.message
+        for r in caplog.records
+    )
+
+
 def test_photon_logger_copies_to_destination(tmp_path):
     dest = tmp_path / "logs" / "job.log"
     with PhotonLogger(dest, level="debug") as log:
@@ -45,6 +59,40 @@ def test_photon_logger_copies_to_destination(tmp_path):
     assert "hello 42" in text and "dbg" in text and "bad" in text
     # idempotent close
     log.close()
+
+
+def test_photon_logger_creates_missing_destination_dirs(tmp_path):
+    """close() must create the destination's parent directories (the
+    reference copies to HDFS paths that may not exist yet) and remove
+    its temp buffer."""
+    dest = tmp_path / "a" / "b" / "c" / "job.log"
+    log = PhotonLogger(dest)
+    tmp_buffer = log._tmp_path
+    log.info("deep %s", "copy")
+    log.close()
+    assert "deep copy" in dest.read_text()
+    assert not os.path.exists(tmp_buffer)
+
+
+def test_event_emitter_failing_listener_does_not_block_later_ones():
+    """Isolation must hold regardless of registration order: a listener
+    registered BEFORE the failing one and one registered AFTER both see
+    every event."""
+    before, after = [], []
+    emitter = EventEmitter()
+    emitter.register(lambda e: before.append(e.name))
+
+    class Boom(EventListener):
+        def on_event(self, event: Event) -> None:
+            raise RuntimeError("listener bug")
+
+    emitter.register(Boom())
+    emitter.register(lambda e: after.append(e.name))
+    emitter.emit("setup")
+    emitter.emit("training_finish")
+    assert before == ["setup", "training_finish"]
+    assert after == ["setup", "training_finish"]
+    emitter.close()
 
 
 def test_event_emitter_dispatch_and_isolation():
