@@ -1,6 +1,21 @@
 """GAME scoring driver (reference cli/game/scoring/GameScoringDriver.scala:
 load a saved GAME model, score a dataset, optionally evaluate, write
-ScoringResultAvro part files)."""
+ScoringResultAvro part files).
+
+Scoring streams by default: avro part files decode in bounded chunks on
+a producer thread, each chunk runs through the fused device scorer
+(``game/scoring.GameScorer`` — one program per batch shape, zero
+steady-state retraces), and finished batches land round-robin in
+sharded ``part-NNNNN.avro`` outputs (score columns buffered, each shard
+flushed through the C++ block writer at close). Host memory holds a
+constant number of decoded feature chunks (two staged on the producer
+side + two in flight in the consumer), never the dataset. Knobs:
+``--score-batch-rows`` / ``PHOTON_SCORE_BATCH_ROWS``,
+``--num-output-partitions`` / ``PHOTON_SCORE_PARTITIONS``,
+``--monolithic-scoring`` forces the legacy materialize-everything path
+(also the automatic fallback for model layouts the fused program cannot
+express).
+"""
 from __future__ import annotations
 
 import argparse
@@ -12,7 +27,11 @@ import numpy as np
 
 from photon_tpu.cli import game_base
 from photon_tpu.game.transformer import GameTransformer
-from photon_tpu.io.model_io import load_game_model, save_scoring_results
+from photon_tpu.io.model_io import (
+    ShardedScoringWriter,
+    load_game_model,
+    save_scoring_results,
+)
 from photon_tpu.util import EventEmitter, PhotonLogger, Timed, prepare_output_dir
 
 SCORES_DIR = "scores"
@@ -32,7 +51,161 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="log per-coordinate model summaries before scoring",
     )
+    p.add_argument(
+        "--score-batch-rows",
+        type=int,
+        default=None,
+        help="rows per streaming score batch (default 8192; env "
+        "PHOTON_SCORE_BATCH_ROWS overrides)",
+    )
+    p.add_argument(
+        "--num-output-partitions",
+        type=int,
+        default=None,
+        help="score output part files, filled round-robin per batch "
+        "(default 1; env PHOTON_SCORE_PARTITIONS overrides)",
+    )
+    p.add_argument(
+        "--monolithic-scoring",
+        action="store_true",
+        help="materialize the full dataset and score it in one host pass "
+        "(the pre-streaming path; also the automatic fallback for model "
+        "layouts the fused scorer cannot express)",
+    )
     return p
+
+
+def _run_evaluators(log, requested, scores, labels, weights, tag_cols) -> dict:
+    """Evaluate on the finite-labeled subset. Scoring data may be
+    partially labeled (the reference scores labeled and unlabeled rows
+    alike); rows without a finite label are excluded from every metric —
+    the same masking convention as weight-0 rows — and the exclusion is
+    logged, instead of one missing label silently skipping ALL
+    evaluators (the old ``np.all(isfinite)`` gate)."""
+    from photon_tpu.evaluation.multi import GroupedEvaluatorSpec
+
+    evaluations: dict = {}
+    if not requested:
+        return evaluations
+    finite = np.isfinite(labels)
+    n_excluded = int(len(labels) - finite.sum())
+    if not finite.any():
+        log.warning("scoring data has no finite labels; skipping evaluators")
+        return evaluations
+    if n_excluded:
+        log.info(
+            "evaluating on %d of %d rows (%d excluded for non-finite labels)",
+            int(finite.sum()), len(labels), n_excluded,
+        )
+    import jax.numpy as jnp
+
+    from photon_tpu.evaluation.evaluators import evaluate
+
+    s_f, lab_f, w_f = scores[finite], labels[finite], weights[finite]
+    s, lab, w = jnp.asarray(s_f), jnp.asarray(lab_f), jnp.asarray(w_f)
+    # weight-0 rows are padding/masked by convention and excluded from
+    # grouped metrics (plain evaluators mask via the weights)
+    keep = w_f > 0
+    for ev in requested:
+        if isinstance(ev, GroupedEvaluatorSpec):
+            ids = np.asarray(tag_cols[ev.id_tag])[finite]
+            evaluations[ev.name] = float(
+                ev.build()(s_f[keep], lab_f[keep], ids[keep])
+            )
+        else:
+            evaluations[ev.name] = float(evaluate(ev, s, lab, w))
+        log.info("%s = %.6f", ev.name, evaluations[ev.name])
+    return evaluations
+
+
+def _score_streaming(
+    args, log, model, index_maps, shard_configs, id_tags, out_root,
+    requested,
+):
+    """Streamed scoring: chunked decode → fused device scorer → sharded
+    avro writers, with the label/weight/id-tag columns (cheap, O(N))
+    accumulated only when evaluators will consume them. Returns None
+    when the model layout needs the monolithic fallback."""
+    from photon_tpu.game.scoring import (
+        UnsupportedModelLayout,
+        score_batch_rows,
+        score_output_partitions,
+    )
+    from photon_tpu.io.data_reader import AvroDataReader
+
+    # knob validation happens BEFORE the layout fallback: a bad
+    # --score-batch-rows / env value must raise, not silently demote the
+    # run to the materialize-everything path
+    batch_rows = score_batch_rows(args.score_batch_rows)
+    partitions = score_output_partitions(args.num_output_partitions)
+    try:
+        scorer = GameTransformer(model=model, task=model.task).streaming_scorer(
+            batch_rows=batch_rows
+        )
+    except UnsupportedModelLayout as e:
+        log.warning("streaming scorer unavailable (%s); falling back to "
+                    "the monolithic path", e)
+        return None
+
+    paths = game_base.resolve_input_paths(args)
+    reader = AvroDataReader(index_maps=index_maps)
+    chunks = reader.iter_chunks(
+        paths, shard_configs, id_tags=tuple(id_tags), chunk_rows=batch_rows
+    )
+    writer = ShardedScoringWriter(
+        os.path.join(out_root, SCORES_DIR),
+        num_partitions=partitions,
+        model_id=args.model_id,
+    )
+    accumulate = bool(requested)
+    labels_acc, weights_acc = [], []
+    tag_acc: dict[str, list] = {t: [] for t in id_tags}
+
+    def on_batch(chunk, scores):
+        writer.write_chunk(
+            scores,
+            labels=chunk.labels,
+            weights=chunk.weights,
+            uids=chunk.uids,
+        )
+        # evaluator columns are O(N) host memory (id tags are Python
+        # object arrays); with no evaluators requested, keep the
+        # bounded-memory promise and accumulate nothing
+        if accumulate:
+            labels_acc.append(chunk.labels)
+            weights_acc.append(chunk.weights)
+            for t in id_tags:
+                tag_acc[t].append(np.asarray(chunk.id_tags[t]))
+
+    with Timed("stream scores"):
+        result = scorer.stream(chunks, on_batch=on_batch)
+        n = writer.close()
+    log.info(
+        "streamed %d samples in %d batches of %d rows -> %d partition(s)",
+        result.stats.samples, result.stats.batches, batch_rows, partitions,
+    )
+    columns = {
+        "labels": (
+            np.concatenate(labels_acc) if labels_acc else np.zeros(0)
+        ),
+        "weights": (
+            np.concatenate(weights_acc) if weights_acc else np.zeros(0)
+        ),
+        "tags": {
+            t: (np.concatenate(v) if v else np.zeros(0, dtype=object))
+            for t, v in tag_acc.items()
+        },
+    }
+    detail = {
+        "mode": "streaming",
+        "batchRows": batch_rows,
+        "numOutputPartitions": partitions,
+        "batches": result.stats.batches,
+        "maxStagedChunks": result.stats.max_staged_chunks,
+        "batchLatency": result.stats.latency_percentiles(),
+        "outputFiles": writer.paths(),
+    }
+    return result.scores, n, columns, detail
 
 
 def run(argv=None) -> dict:
@@ -72,57 +245,59 @@ def run(argv=None) -> dict:
             if isinstance(ev, GroupedEvaluatorSpec)
         }
         id_tags = sorted(model.required_id_tags() | evaluator_tags)
-        with Timed("read scoring data"):
-            paths = game_base.resolve_input_paths(args)
-            data, _ = game_base.read_game_data(
-                paths, shard_configs, index_maps, id_tags
+
+        streamed = (
+            None
+            if args.monolithic_scoring
+            else _score_streaming(
+                args, log, model, index_maps, shard_configs, id_tags,
+                out_root, requested,
             )
-        log.info("scoring %d samples", data.num_samples)
+        )
+        if streamed is not None:
+            scores, n, columns, score_detail = streamed
+            log.info("scored %d samples (streaming)", n)
+        else:
+            with Timed("read scoring data"):
+                paths = game_base.resolve_input_paths(args)
+                data, _ = game_base.read_game_data(
+                    paths, shard_configs, index_maps, id_tags
+                )
+            log.info("scoring %d samples (monolithic)", data.num_samples)
+            transformer = GameTransformer(model=model, task=model.task)
+            with Timed("score"):
+                scores = np.asarray(transformer.score(data))
+            with Timed("save scores"):
+                n = save_scoring_results(
+                    os.path.join(out_root, SCORES_DIR, "part-00000.avro"),
+                    scores,
+                    model_id=args.model_id,
+                    labels=data.labels,
+                    weights=data.weights,
+                    uids=data.uids,
+                )
+            columns = {
+                "labels": data.labels,
+                "weights": data.weights,
+                "tags": {t: data.id_tags[t] for t in id_tags},
+            }
+            score_detail = {"mode": "monolithic"}
 
-        transformer = GameTransformer(model=model, task=model.task)
-        with Timed("score"):
-            scores = np.asarray(transformer.score(data))
-
-        evaluations = {}
-        has_labels = bool(np.all(np.isfinite(data.labels)))
-        if requested and not has_labels:
-            log.warning("scoring data has missing labels; skipping evaluators")
-        elif requested:
-            import jax.numpy as jnp
-
-            from photon_tpu.evaluation.evaluators import evaluate
-
-            s = jnp.asarray(scores)
-            lab = jnp.asarray(data.labels)
-            w = jnp.asarray(data.weights)
-            # weight-0 rows are padding/masked by convention and excluded
-            # from grouped metrics (plain evaluators mask via the weights)
-            keep = np.asarray(data.weights) > 0
-            for ev in requested:
-                if isinstance(ev, GroupedEvaluatorSpec):
-                    evaluations[ev.name] = float(
-                        ev.build()(
-                            scores[keep],
-                            data.labels[keep],
-                            np.asarray(data.id_tags[ev.id_tag])[keep],
-                        )
-                    )
-                else:
-                    evaluations[ev.name] = float(evaluate(ev, s, lab, w))
-                log.info("%s = %.6f", ev.name, evaluations[ev.name])
-
-        with Timed("save scores"):
-            n = save_scoring_results(
-                os.path.join(out_root, SCORES_DIR, "part-00000.avro"),
-                scores,
-                model_id=args.model_id,
-                labels=data.labels,
-                weights=data.weights,
-                uids=data.uids,
-            )
+        evaluations = _run_evaluators(
+            log, requested, scores,
+            np.asarray(columns["labels"], dtype=np.float64),
+            np.asarray(columns["weights"], dtype=np.float64),
+            columns["tags"],
+        )
         with open(os.path.join(out_root, "scoring-summary.json"), "w") as f:
             json.dump(
-                {"numScored": n, "evaluations": evaluations}, f, indent=2
+                {
+                    "numScored": n,
+                    "evaluations": evaluations,
+                    "scoring": score_detail,
+                },
+                f,
+                indent=2,
             )
         game_base.export_run_profile(
             out_root, log, meta={"driver": "game_scoring"}

@@ -11,4 +11,5 @@ from photon_tpu.game.model import (  # noqa: F401
     MatrixFactorizationModel,
     RandomEffectModel,
 )
+from photon_tpu.game.scoring import GameScorer  # noqa: F401
 from photon_tpu.game.transformer import GameTransformer  # noqa: F401

@@ -155,6 +155,79 @@ class GameData:
         )
 
 
+def slice_game_data(data: GameData, lo: int, hi: int) -> GameData:
+    """Row-range view ``[lo, hi)`` of a GameData (CSR rows re-based so the
+    slice is self-contained — the unit the streaming scorer consumes)."""
+    lo = max(0, int(lo))
+    hi = min(data.num_samples, int(hi))
+    shards = {}
+    for name, m in data.feature_shards.items():
+        nz_lo, nz_hi = int(m.indptr[lo]), int(m.indptr[hi])
+        shards[name] = CSRMatrix(
+            indptr=(m.indptr[lo : hi + 1] - nz_lo).astype(m.indptr.dtype),
+            indices=m.indices[nz_lo:nz_hi],
+            values=m.values[nz_lo:nz_hi],
+            num_cols=m.num_cols,
+        )
+    return GameData(
+        labels=data.labels[lo:hi],
+        offsets=data.offsets[lo:hi],
+        weights=data.weights[lo:hi],
+        feature_shards=shards,
+        id_tags={t: np.asarray(col)[lo:hi] for t, col in data.id_tags.items()},
+        uids=None if data.uids is None else list(data.uids[lo:hi]),
+    )
+
+
+def concat_game_data(pieces: Sequence[GameData]) -> GameData:
+    """Concatenate GameData pieces row-wise (same shards / id tags / uid
+    presence required). Used by the streaming chunk assembler to carry
+    partial rows across avro part-file boundaries."""
+    if not pieces:
+        raise ValueError("concat_game_data needs at least one piece")
+    if len(pieces) == 1:
+        return pieces[0]
+    first = pieces[0]
+    shard_names = set(first.feature_shards)
+    tag_names = set(first.id_tags)
+    for p in pieces[1:]:
+        if set(p.feature_shards) != shard_names or set(p.id_tags) != tag_names:
+            raise ValueError("GameData pieces disagree on shards or id tags")
+        if (p.uids is None) != (first.uids is None):
+            raise ValueError("GameData pieces disagree on uid presence")
+    shards = {}
+    for name in first.feature_shards:
+        mats = [p.feature_shards[name] for p in pieces]
+        num_cols = mats[0].num_cols
+        if any(m.num_cols != num_cols for m in mats):
+            raise ValueError(f"shard {name} width differs across pieces")
+        indptrs = [mats[0].indptr]
+        base = int(mats[0].indptr[-1])
+        for m in mats[1:]:
+            indptrs.append(m.indptr[1:] + base)
+            base += int(m.indptr[-1])
+        shards[name] = CSRMatrix(
+            indptr=np.concatenate(indptrs),
+            indices=np.concatenate([m.indices for m in mats]),
+            values=np.concatenate([m.values for m in mats]),
+            num_cols=num_cols,
+        )
+    uids = None
+    if first.uids is not None:
+        uids = [u for p in pieces for u in p.uids]
+    return GameData(
+        labels=np.concatenate([p.labels for p in pieces]),
+        offsets=np.concatenate([p.offsets for p in pieces]),
+        weights=np.concatenate([p.weights for p in pieces]),
+        feature_shards=shards,
+        id_tags={
+            t: np.concatenate([np.asarray(p.id_tags[t]) for p in pieces])
+            for t in first.id_tags
+        },
+        uids=uids,
+    )
+
+
 def entity_row_indices(index, keys, oov: int) -> np.ndarray:
     """Map entity keys to dense table rows, ``oov`` for unseen keys — the
     scoring-time entity lookup shared by random-effect and MF models."""

@@ -11,6 +11,7 @@ einsum per bucket + scatter, not a join.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Mapping
 
 import jax.numpy as jnp
@@ -20,6 +21,15 @@ from photon_tpu.game.data import GameData, RandomEffectDataset
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel, model_for_task
 from photon_tpu.types import Array, TaskType
+
+
+def _build_vocab_index(vocab: np.ndarray) -> dict:
+    """entity key → dense table row. One build site so the scoring-time
+    memoization (``cached_property`` on the models below — legal on frozen
+    dataclasses, which still carry ``__dict__``) is pinnable by test: at
+    millions of entities this dict costs ~seconds, and the old per-call
+    rebuild paid it on EVERY ``score_cold`` chunk."""
+    return {k: i for i, k in enumerate(vocab)}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +88,12 @@ class RandomEffectModel:
             np.add.at(scores, bucket.score_pos, s)
         return scores
 
+    @functools.cached_property
+    def entity_row_index(self) -> dict:
+        """Memoized entity key → coefficient-table row (shared by
+        ``score_cold`` and the streaming scorer's per-chunk host lookup)."""
+        return _build_vocab_index(self.vocab)
+
     def _entity_coefficient_csr(self):
         """[num_entities(+1 zero row), d] sparse coefficient matrix, cached.
 
@@ -113,9 +129,8 @@ class RandomEffectModel:
         csr = sparse.csr_matrix(
             (vals, (rows, cols)), shape=(len(self.vocab) + 1, d)
         )
-        index = {k: i for i, k in enumerate(self.vocab)}
-        object.__setattr__(self, "_coef_csr_cache", (csr, index))
-        return csr, index
+        object.__setattr__(self, "_coef_csr_cache", (csr, self.entity_row_index))
+        return csr, self.entity_row_index
 
     def score_cold(self, data: GameData) -> np.ndarray:
         """Score arbitrary data by entity lookup (unseen entities → 0),
@@ -277,9 +292,17 @@ class MatrixFactorizationModel:
     def num_factors(self) -> int:
         return self.row_factors.shape[1]
 
+    @functools.cached_property
+    def row_index(self) -> dict:
+        """Memoized row-entity key → factor-table row."""
+        return _build_vocab_index(self.row_vocab)
+
+    @functools.cached_property
+    def col_index(self) -> dict:
+        """Memoized col-entity key → factor-table row."""
+        return _build_vocab_index(self.col_vocab)
+
     def score_cold(self, data: GameData) -> np.ndarray:
-        row_index = {k: i for i, k in enumerate(self.row_vocab)}
-        col_index = {k: i for i, k in enumerate(self.col_vocab)}
         # zero row at the end for unseen entities
         u = np.concatenate(
             [self.row_factors, np.zeros((1, self.num_factors))]
@@ -290,10 +313,12 @@ class MatrixFactorizationModel:
         from photon_tpu.game.data import entity_row_indices
 
         ri = entity_row_indices(
-            row_index, data.id_tags[self.row_entity_type], len(row_index)
+            self.row_index, data.id_tags[self.row_entity_type],
+            len(self.row_index),
         )
         ci = entity_row_indices(
-            col_index, data.id_tags[self.col_entity_type], len(col_index)
+            self.col_index, data.id_tags[self.col_entity_type],
+            len(self.col_index),
         )
         return np.einsum("nk,nk->n", u[ri], v[ci])
 

@@ -24,8 +24,21 @@ class GameTransformer:
 
     def score(self, data: GameData) -> np.ndarray:
         """Total margin per sample: Σ coordinate scores + data offsets
-        (reference ModelDataScores carries offsets through evaluation)."""
+        (reference ModelDataScores carries offsets through evaluation).
+
+        This is the MONOLITHIC host path (numpy per coordinate over the
+        full dataset) — the parity oracle for the fused streaming engine
+        and the fallback for model layouts it cannot express."""
         return self.model.score(data) + data.offsets
+
+    def streaming_scorer(self, **kwargs):
+        """A fused, streamable device scorer for this model (see
+        :class:`photon_tpu.game.scoring.GameScorer`); raises
+        :class:`photon_tpu.game.scoring.UnsupportedModelLayout` for
+        layouts the fused program cannot express."""
+        from photon_tpu.game.scoring import GameScorer
+
+        return GameScorer(self.model, **kwargs)
 
     def predict(self, data: GameData) -> np.ndarray:
         return self.model.predict(data)

@@ -274,36 +274,35 @@ def _decode(r: _Reader, schema: Any, registry) -> Any:
 # ---------------------------------------------------------------------------
 
 
-def write_avro_file(
-    path: str | os.PathLike,
-    schema: dict,
-    records: Iterable[dict],
-    codec: str = "deflate",
-    sync_interval: int = 4000,
-) -> int:
-    """Write records to an Avro object container file; returns the count."""
-    if codec not in ("null", "deflate"):
-        raise ValueError(f"unsupported codec {codec!r}")
-    registry: dict[str, dict] = {}
-    _collect_named(schema, registry)
-    sync = os.urandom(SYNC_SIZE)
+class AvroFileWriter:
+    """Incremental Avro object-container writer: the header goes out at
+    open, each ``append`` call encodes records into sync-marker-delimited
+    blocks, and ``close`` flushes the final partial block. The streaming
+    score pipeline appends one chunk at a time to each output shard while
+    the next batch computes — wire format identical to
+    :func:`write_avro_file` (which is now a thin wrapper)."""
 
-    def flush_block(f, block: io.BytesIO, count: int) -> None:
-        if count == 0:
-            return
-        payload = block.getvalue()
-        if codec == "deflate":
-            payload = zlib.compress(payload)[2:-4]  # raw deflate per spec
-        head = io.BytesIO()
-        _write_long(head, count)
-        _write_long(head, len(payload))
-        f.write(head.getvalue())
-        f.write(payload)
-        f.write(sync)
-
-    total = 0
-    with open(path, "wb") as f:
-        f.write(MAGIC)
+    def __init__(
+        self,
+        path: str | os.PathLike,
+        schema: dict,
+        codec: str = "deflate",
+        sync_interval: int = 4000,
+    ):
+        if codec not in ("null", "deflate"):
+            raise ValueError(f"unsupported codec {codec!r}")
+        self.path = path
+        self.schema = schema
+        self.codec = codec
+        self.sync_interval = sync_interval
+        self._registry: dict[str, dict] = {}
+        _collect_named(schema, self._registry)
+        self._sync = os.urandom(SYNC_SIZE)
+        self._block = io.BytesIO()
+        self._count = 0
+        self.total = 0
+        self._f = open(path, "wb")
+        self._f.write(MAGIC)
         meta = io.BytesIO()
         _encode(
             meta,
@@ -312,23 +311,76 @@ def write_avro_file(
                 "avro.schema": json.dumps(schema).encode("utf-8"),
                 "avro.codec": codec.encode("utf-8"),
             },
-            registry,
+            self._registry,
         )
-        f.write(meta.getvalue())
-        f.write(sync)
+        self._f.write(meta.getvalue())
+        self._f.write(self._sync)
 
-        block = io.BytesIO()
-        count = 0
+    def _flush_block(self) -> None:
+        if self._count == 0:
+            return
+        payload = self._block.getvalue()
+        if self.codec == "deflate":
+            payload = zlib.compress(payload)[2:-4]  # raw deflate per spec
+        head = io.BytesIO()
+        _write_long(head, self._count)
+        _write_long(head, len(payload))
+        self._f.write(head.getvalue())
+        self._f.write(payload)
+        self._f.write(self._sync)
+        self._block = io.BytesIO()
+        self._count = 0
+
+    def append(self, records: Iterable[dict]) -> int:
+        """Encode records into the open container; returns how many.
+
+        A record that fails mid-encode is rolled back to its start
+        offset, so the open block stays decodable (its declared count
+        only ever covers fully-encoded records)."""
+        n = 0
         for rec in records:
-            _encode(block, schema, rec, registry)
-            count += 1
-            total += 1
-            if count >= sync_interval:
-                flush_block(f, block, count)
-                block = io.BytesIO()
-                count = 0
-        flush_block(f, block, count)
-    return total
+            pos = self._block.tell()
+            try:
+                _encode(self._block, self.schema, rec, self._registry)
+            except BaseException:
+                self._block.seek(pos)
+                self._block.truncate()
+                raise
+            self._count += 1
+            n += 1
+            if self._count >= self.sync_interval:
+                self._flush_block()
+        self.total += n
+        return n
+
+    def close(self) -> int:
+        """Flush the trailing block and close; returns the total count."""
+        if self._f is not None:
+            self._flush_block()
+            self._f.close()
+            self._f = None
+        return self.total
+
+    def __enter__(self) -> "AvroFileWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def write_avro_file(
+    path: str | os.PathLike,
+    schema: dict,
+    records: Iterable[dict],
+    codec: str = "deflate",
+    sync_interval: int = 4000,
+) -> int:
+    """Write records to an Avro object container file; returns the count."""
+    with AvroFileWriter(
+        path, schema, codec=codec, sync_interval=sync_interval
+    ) as w:
+        w.append(records)
+    return w.total
 
 
 def iter_avro_file(path: str | os.PathLike) -> Iterator[dict]:
@@ -380,12 +432,13 @@ def read_schema(path: str | os.PathLike) -> dict:
     return json.loads(meta["avro.schema"].decode("utf-8"))
 
 
-def read_avro_dir(path: str | os.PathLike) -> Iterator[dict]:
-    """Read all ``*.avro`` part files under a directory (sorted), or a
-    single file — the reference's multi-part HDFS dir convention."""
+def avro_part_files(path: str | os.PathLike) -> list[str]:
+    """The ``*.avro`` part files a path denotes: the file itself, or the
+    sorted parts under a directory — the reference's multi-part HDFS dir
+    convention (one enumeration site shared by the monolithic and the
+    chunked/streaming readers)."""
     if os.path.isfile(path):
-        yield from iter_avro_file(path)
-        return
+        return [str(path)]
     parts = sorted(
         os.path.join(path, p)
         for p in os.listdir(path)
@@ -393,5 +446,11 @@ def read_avro_dir(path: str | os.PathLike) -> Iterator[dict]:
     )
     if not parts:
         raise FileNotFoundError(f"no .avro files under {path}")
-    for p in parts:
+    return parts
+
+
+def read_avro_dir(path: str | os.PathLike) -> Iterator[dict]:
+    """Read all ``*.avro`` part files under a directory (sorted), or a
+    single file."""
+    for p in avro_part_files(path):
         yield from iter_avro_file(p)

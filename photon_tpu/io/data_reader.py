@@ -113,6 +113,75 @@ class AvroDataReader:
         with obs.span("io.read", paths=len(paths)) as read_span:
             return self._read(paths, shard_configs, id_tags, read_span)
 
+    def iter_chunks(
+        self,
+        paths: str | Sequence[str],
+        shard_configs: Mapping[str, FeatureShardConfig],
+        *,
+        id_tags: Sequence[str] = (),
+        chunk_rows: int = 8192,
+    ):
+        """Stream ``GameData`` chunks of exactly ``chunk_rows`` rows (last
+        chunk smaller) without materializing the full dataset.
+
+        Decode proceeds one avro part file at a time (each file still
+        rides the C++ columnar fast path of :meth:`read`), so peak host
+        memory is bounded by one part file plus the chunk assembly buffer
+        — never the dataset. Rows carry over across file boundaries, so
+        chunk shapes stay stable for the streaming scorer's shape-bucket
+        policy regardless of how the input was partitioned.
+
+        Requires the index maps to be known up front (the scoring path
+        always has them — from the off-heap store or the model's own
+        vocabulary): generating maps needs a full pass over the data,
+        which is exactly what streaming avoids.
+        """
+        from photon_tpu.game.data import concat_game_data, slice_game_data
+        from photon_tpu.io.avro import avro_part_files
+
+        if not set(shard_configs) <= set(self.index_maps):
+            missing = sorted(set(shard_configs) - set(self.index_maps))
+            raise ValueError(
+                "chunked reads need index maps for every shard up front "
+                f"(missing: {missing}); generating them requires a full "
+                "pass over the data"
+            )
+        if chunk_rows < 1:
+            raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+        if isinstance(paths, (str, bytes)):
+            paths = [paths]
+        files = [f for p in paths for f in avro_part_files(p)]
+        pending: list[GameData] = []
+        buffered = 0
+        for f in files:
+            piece = self.read(f, shard_configs, id_tags=id_tags)
+            if piece.num_samples == 0:
+                continue
+            pending.append(piece)
+            buffered += piece.num_samples
+            if buffered < chunk_rows:
+                continue
+            # merge ONCE, then slice every full chunk out of the merge —
+            # re-concatenating the shrinking remainder per chunk would
+            # copy O(chunks × remainder); and when the merge aligns
+            # exactly on a chunk boundary, hand it over without a copy
+            merged = concat_game_data(pending)
+            lo = 0
+            while merged.num_samples - lo >= chunk_rows:
+                if lo == 0 and merged.num_samples == chunk_rows:
+                    yield merged
+                else:
+                    yield slice_game_data(merged, lo, lo + chunk_rows)
+                lo += chunk_rows
+            if lo < merged.num_samples:
+                pending = [slice_game_data(merged, lo, merged.num_samples)]
+                buffered = merged.num_samples - lo
+            else:
+                pending = []
+                buffered = 0
+        if buffered:
+            yield concat_game_data(pending)
+
     def _read(self, paths, shard_configs, id_tags, read_span):
         if os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
             with obs.span("io.decode", decoder="native") as native_span:

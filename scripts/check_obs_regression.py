@@ -42,7 +42,8 @@ BASELINE_PATH = os.path.join(os.path.dirname(__file__), "obs_baseline.json")
 SNAPSHOT_SCHEMA = 1
 
 #: span names whose per-run counts are structural (exact): the fit →
-#: data build → precompile → sweep → coordinate taxonomy itself
+#: data build → precompile → sweep → coordinate taxonomy itself, plus
+#: the streaming-scorer root
 STRUCTURAL_SPANS = (
     "fit",
     "fit.data_build",
@@ -52,7 +53,11 @@ STRUCTURAL_SPANS = (
     "descent.sweep",
     "descent.coordinate",
     "descent.barrier",
+    "score.stream",
 )
+
+#: rows per canonical streaming-score batch (400 samples → 4 batches)
+SCORE_BATCH_ROWS = 128
 
 
 def build_canonical_fit():
@@ -116,8 +121,9 @@ def build_canonical_fit():
 
 
 def collect_snapshot() -> dict:
-    """Run the canonical fit under a clean telemetry pipeline and return
-    the metric-shape snapshot."""
+    """Run the canonical fit (and a canonical streaming score of the
+    fitted model — the ``score.*`` taxonomy) under a clean telemetry
+    pipeline and return the metric-shape snapshot."""
     import jax
 
     from photon_tpu import obs
@@ -132,11 +138,30 @@ def collect_snapshot() -> dict:
     # instead of code shape
     cache_dir = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_compilation_cache_dir", None)
+    # the scoring knob env vars win over explicit GameScorer arguments
+    # (documented PR-3 precedence); a developer's exported
+    # PHOTON_SCORE_BATCH_ROWS would change the canonical batch count and
+    # fail the abs_tol=0 score.* bands with no code change — pin them off
+    saved_env = {
+        k: os.environ.pop(k)
+        for k in list(os.environ)
+        if k.startswith("PHOTON_SCORE_")
+    }
     try:
         results = est.fit(data)
+        # canonical streaming score: the fitted model over the same 400
+        # rows in fixed-size batches — emits the score.* spans/counters
+        # (score.stream root, per-batch ingest/h2d/readback, batches/
+        # samples/padded_rows counters, batch_seconds histogram)
+        from photon_tpu.game.scoring import GameScorer
+
+        GameScorer(
+            results[0].model, batch_rows=SCORE_BATCH_ROWS
+        ).score_data(data)
     finally:
         obs.disable()
         jax.config.update("jax_compilation_cache_dir", cache_dir)
+        os.environ.update(saved_env)
     snap = obs.get_registry().snapshot()
     # cache hit/miss counts also track environment cache state — they are
     # real telemetry but not part of the banded metric SHAPE

@@ -332,6 +332,115 @@ def test_scoring_unlabeled_data_skips_evaluators(trained_model_dir, tmp_path):
     assert np.all(np.isfinite(res["scores"]))
 
 
+def test_scoring_partially_labeled_data_evaluates_finite_subset(
+    trained_model_dir, tmp_path
+):
+    """One missing label must NOT skip every evaluator (the old
+    all-or-nothing ``np.all(isfinite)`` gate): metrics are computed over
+    the finite-labeled subset and the exclusion is logged."""
+    out, _ = trained_model_dir
+    data_dir = tmp_path / "partial"
+    data_dir.mkdir()
+    recs = _make_records(4, n=80)
+    # a nullable-label schema: 30 of 80 rows lose their label
+    schema = {
+        **TRAINING_EXAMPLE_AVRO,
+        "fields": [
+            {"name": "label", "type": ["null", "double"], "default": None}
+            if f["name"] == "label"
+            else f
+            for f in TRAINING_EXAMPLE_AVRO["fields"]
+        ],
+    }
+    for r in recs[:30]:
+        r["label"] = None
+    write_avro_file(data_dir / "part-00000.avro", schema, recs)
+    res = game_scoring.run(
+        [
+            "--input-data-directories", str(data_dir),
+            "--root-output-directory", str(tmp_path / "sout"),
+            "--feature-shard-configurations", SHARD_ARG,
+            "--model-input-directory", str(out / "best"),
+            "--evaluators", "AUC",
+        ]
+    )
+    # every row is scored, but AUC comes from the 50 labeled ones
+    assert len(res["scores"]) == 80
+    assert np.all(np.isfinite(res["scores"]))
+    assert 0.5 < res["evaluations"]["AUC"] <= 1.0
+    log_text = (tmp_path / "sout" / "driver.log").read_text()
+    assert "30 excluded for non-finite labels" in log_text
+
+
+def test_scoring_driver_sharded_streaming_output(
+    avro_data, trained_model_dir, tmp_path
+):
+    """The streaming driver's chunking/sharding knobs: small batches, two
+    output partitions; the shards together hold every row once and agree
+    with the returned score array."""
+    out, _ = trained_model_dir
+    score_out = tmp_path / "scoring"
+    res = game_scoring.run(
+        [
+            "--input-data-directories", str(avro_data / "valid"),
+            "--root-output-directory", str(score_out),
+            "--feature-shard-configurations", SHARD_ARG,
+            "--model-input-directory", str(out / "best"),
+            "--score-batch-rows", "64",
+            "--num-output-partitions", "2",
+            "--model-id", "m2",
+        ]
+    )
+    parts = sorted(p.name for p in (score_out / "scores").iterdir())
+    assert parts == ["part-00000.avro", "part-00001.avro"]
+    records = [r for p in parts for r in read_avro_file(score_out / "scores" / p)]
+    assert len(records) == 200 == len(res["scores"])
+    by_uid = {r["uid"]: r["predictionScore"] for r in records}
+    recs_in = _make_records(1, n=200)
+    for i in (0, 63, 64, 199):
+        np.testing.assert_allclose(
+            by_uid[recs_in[i]["uid"]], res["scores"][i], rtol=1e-6
+        )
+    summary = json.loads((score_out / "scoring-summary.json").read_text())
+    assert summary["scoring"]["mode"] == "streaming"
+    assert summary["scoring"]["batchRows"] == 64
+    assert summary["scoring"]["numOutputPartitions"] == 2
+    assert summary["scoring"]["batches"] == 4
+
+    # the escape hatch still produces the single-part monolithic layout
+    mono_out = tmp_path / "scoring-mono"
+    mres = game_scoring.run(
+        [
+            "--input-data-directories", str(avro_data / "valid"),
+            "--root-output-directory", str(mono_out),
+            "--feature-shard-configurations", SHARD_ARG,
+            "--model-input-directory", str(out / "best"),
+            "--monolithic-scoring",
+        ]
+    )
+    np.testing.assert_allclose(mres["scores"], res["scores"], rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_scoring_driver_bad_batch_rows_raises(
+    avro_data, trained_model_dir, tmp_path
+):
+    """An invalid --score-batch-rows must raise, not silently demote the
+    run to the materialize-everything monolithic path (only an
+    UnsupportedModelLayout triggers that fallback)."""
+    out, _ = trained_model_dir
+    with pytest.raises(ValueError, match="batch rows"):
+        game_scoring.run(
+            [
+                "--input-data-directories", str(avro_data / "valid"),
+                "--root-output-directory", str(tmp_path / "scoring"),
+                "--feature-shard-configurations", SHARD_ARG,
+                "--model-input-directory", str(out / "best"),
+                "--score-batch-rows", "0",
+            ]
+        )
+
+
 def test_game_training_validates_validation_data(avro_data, tmp_path):
     bad_dir = tmp_path / "bad-valid"
     bad_dir.mkdir()

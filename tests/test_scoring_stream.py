@@ -1,0 +1,596 @@
+"""Streaming inference engine tests (game/scoring.py + the io plumbing):
+streaming-vs-monolithic parity across chunk sizes, bounded host staging,
+sharded score output round trips, zero steady-state retraces, AOT
+precompile, the chunked reader, and the memoized entity-index satellite.
+"""
+import os
+import time
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_tpu.game.data import (
+    CSRMatrix,
+    GameData,
+    concat_game_data,
+    slice_game_data,
+)
+from photon_tpu.game.model import (
+    BucketCoefficients,
+    FixedEffectModel,
+    GameModel,
+    MatrixFactorizationModel,
+    RandomEffectModel,
+)
+from photon_tpu.game.scoring import (
+    GameScorer,
+    score_batch_rows,
+    score_output_partitions,
+)
+from photon_tpu.game.transformer import GameTransformer
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.glm import model_for_task
+from photon_tpu.types import TaskType
+from photon_tpu.util import compile_watch
+
+N_USERS = 12
+N_MODELED = 10  # 2 users stay unseen → cold rows must score their FE only
+D_FE = 9
+D_RE = 5
+
+
+def _make_model(seed=0, projection=False):
+    rng = np.random.default_rng(seed)
+    task = TaskType.LINEAR_REGRESSION
+    fe = FixedEffectModel(
+        model=model_for_task(
+            task, Coefficients(means=jnp.asarray(rng.normal(size=D_FE)))
+        ),
+        feature_shard="g",
+    )
+    vocab = np.array(sorted(f"u{i}" for i in range(N_MODELED)))
+    e_n = len(vocab)
+    if projection:
+        k = 3
+        proj = rng.normal(size=(D_RE, k))
+        bucket = BucketCoefficients(
+            entity_ids=np.arange(e_n),
+            col_index=np.tile(np.arange(k), (e_n, 1)),
+            coefficients=rng.normal(size=(e_n, k)),
+        )
+        re = RandomEffectModel(
+            random_effect_type="userId",
+            feature_shard="u",
+            task=task,
+            vocab=vocab,
+            buckets=(bucket,),
+            num_features=D_RE,
+            projection_matrix=proj,
+        )
+    else:
+        # two buckets of different widths — the packed device table must
+        # cover both local spaces
+        ids_a, ids_b = np.arange(0, 6), np.arange(6, e_n)
+        re = RandomEffectModel(
+            random_effect_type="userId",
+            feature_shard="u",
+            task=task,
+            vocab=vocab,
+            buckets=(
+                BucketCoefficients(
+                    entity_ids=ids_a,
+                    col_index=np.tile(np.arange(D_RE), (len(ids_a), 1)),
+                    coefficients=rng.normal(size=(len(ids_a), D_RE)),
+                ),
+                BucketCoefficients(
+                    entity_ids=ids_b,
+                    col_index=np.pad(
+                        np.tile(np.arange(3), (len(ids_b), 1)),
+                        ((0, 0), (0, 1)),
+                        constant_values=-1,
+                    ),
+                    coefficients=np.pad(
+                        rng.normal(size=(len(ids_b), 3)), ((0, 0), (0, 1))
+                    ),
+                ),
+            ),
+            num_features=D_RE,
+        )
+    mf = MatrixFactorizationModel(
+        row_entity_type="userId",
+        col_entity_type="itemId",
+        row_vocab=np.array([f"u{i}" for i in range(N_USERS)]),
+        col_vocab=np.array([f"it{i}" for i in range(4)]),
+        row_factors=rng.normal(size=(N_USERS, 3)),
+        col_factors=rng.normal(size=(4, 3)),
+    )
+    return GameModel(
+        coordinates={"fixed": fe, "per-user": re, "mf": mf}, task=task
+    )
+
+
+def _make_data(n=300, seed=1, entity_sorted=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, D_FE))
+    x[rng.uniform(size=(n, D_FE)) < 0.5] = 0.0
+    xr = rng.normal(size=(n, D_RE))
+    ids = rng.integers(0, N_USERS, size=n)  # includes unseen u10/u11
+    if entity_sorted:
+        order = np.argsort(ids, kind="stable")
+        x, xr, ids = x[order], xr[order], ids[order]
+    return GameData.build(
+        labels=rng.normal(size=n),
+        feature_shards={
+            "g": CSRMatrix.from_dense(x),
+            "u": CSRMatrix.from_dense(xr),
+        },
+        offsets=rng.normal(size=n),
+        id_tags={
+            "userId": [f"u{i}" for i in ids],
+            "itemId": [f"it{i % 5}" for i in range(n)],  # it4 unseen
+        },
+        uids=[f"s{i}" for i in range(n)],
+    )
+
+
+# ---------------------------------------------------------------------------
+# parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("batch_rows", [32, 100, 300, 512])
+def test_streaming_matches_monolithic_across_chunk_sizes(batch_rows):
+    model = _make_model()
+    data = _make_data()
+    mono = GameTransformer(model=model, task=model.task).score(data)
+    scorer = GameScorer(model, batch_rows=batch_rows)
+    streamed = scorer.score_data(data)
+    np.testing.assert_allclose(streamed, mono, rtol=1e-5, atol=1e-5)
+
+
+def test_chunk_boundary_mid_entity_group_and_unseen_entities():
+    """Entity-sorted data with a chunk size that splits one entity's rows
+    across a batch boundary; unseen users (u10/u11) and the unseen item
+    (it4) must score exactly their fixed-effect + offset contribution."""
+    model = _make_model()
+    data = _make_data(n=257, entity_sorted=True)
+    mono = GameTransformer(model=model, task=model.task).score(data)
+    streamed = GameScorer(model, batch_rows=64).score_data(data)
+    np.testing.assert_allclose(streamed, mono, rtol=1e-5, atol=1e-5)
+    # unseen entities really are cold: RE + MF contribute 0 there
+    cold = np.isin(
+        np.asarray(data.id_tags["userId"]), ["u10", "u11"]
+    ) & (np.asarray(data.id_tags["itemId"]) == "it4")
+    assert cold.any()
+    fe_only = model["fixed"].score(data) + data.offsets
+    np.testing.assert_allclose(
+        streamed[cold], fe_only[cold], rtol=1e-5, atol=1e-5
+    )
+
+
+def test_streaming_matches_monolithic_with_projection():
+    model = _make_model(projection=True)
+    data = _make_data()
+    mono = GameTransformer(model=model, task=model.task).score(data)
+    streamed = GameScorer(model, batch_rows=128).score_data(data)
+    np.testing.assert_allclose(streamed, mono, rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_streaming_scorer_entry_point():
+    model = _make_model()
+    tr = GameTransformer(model=model, task=model.task)
+    data = _make_data(n=64)
+    np.testing.assert_allclose(
+        tr.streaming_scorer(batch_rows=32).score_data(data),
+        tr.score(data),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_wide_dense_random_effect_rejected():
+    """A no-projection RE on a shard wider than the dense gather limit
+    must refuse at construction (drivers fall back to monolithic)."""
+    model = _make_model()
+    with pytest.raises(ValueError, match="dense gather limit"):
+        GameScorer(model, dense_cols_max=D_RE - 1)
+
+
+# ---------------------------------------------------------------------------
+# retraces / AOT
+# ---------------------------------------------------------------------------
+
+
+def test_zero_steady_state_retraces():
+    model = _make_model()
+    data = _make_data(n=500)
+    scorer = GameScorer(model, batch_rows=128)
+    scorer.score_data(data)  # warm: pays the one compile per shape
+    before = compile_watch.snapshot()
+    scorer.score_data(data)
+    scorer.score_data(data)
+    delta = compile_watch.delta(before)
+    assert delta["backend_compiles"] == 0, delta
+
+
+def test_aot_precompile_serves_the_stream():
+    model = _make_model()
+    data = _make_data(n=300)
+    mono = GameTransformer(model=model, task=model.task).score(data)
+    scorer = GameScorer(model, batch_rows=128)
+    widths = {
+        shard: int(
+            np.diff(data.feature_shards[shard].indptr).max()
+        )
+        for shard in ("g", "u")
+    }
+    report = scorer.precompile(ell_widths=widths)
+    assert report["program"] == "score"
+    before = compile_watch.snapshot()
+    streamed = scorer.score_data(data)
+    assert compile_watch.delta(before)["backend_compiles"] == 0
+    np.testing.assert_allclose(streamed, mono, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pipeline: bounded staging, error propagation, stats
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_host_staging():
+    """With a slow consumer the producer must stall: at no point may more
+    than 2 fully-decoded chunks be staged (1 queued + 1 blocked put)."""
+    model = _make_model()
+    data = _make_data(n=960)
+    scorer = GameScorer(model, batch_rows=64)
+
+    def chunks():
+        for lo in range(0, 960, 64):
+            yield slice_game_data(data, lo, lo + 64)
+
+    def slow_sink(chunk, scores):
+        time.sleep(0.01)
+
+    res = scorer.stream(chunks(), on_batch=slow_sink)
+    assert res.stats.batches == 15
+    assert res.stats.samples == 960
+    assert 1 <= res.stats.max_staged_chunks <= 2
+    assert res.stats.compiles["backend_compiles"] >= 0
+    assert len(res.stats.batch_walls_s) == 15
+
+
+def test_stream_propagates_decode_errors():
+    model = _make_model()
+    data = _make_data(n=64)
+
+    def chunks():
+        yield slice_game_data(data, 0, 64)
+        raise RuntimeError("decode exploded")
+
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        GameScorer(model, batch_rows=64).stream(chunks())
+
+
+def test_stream_batch_order_and_padding_counter():
+    model = _make_model()
+    data = _make_data(n=150)  # 150 = 64 + 64 + 22 → 42 padded rows
+    scorer = GameScorer(model, batch_rows=64)
+    seen = []
+    res = scorer.stream(
+        (
+            slice_game_data(data, lo, min(lo + 64, 150))
+            for lo in range(0, 150, 64)
+        ),
+        on_batch=lambda c, s: seen.append((c.uids[0], len(s))),
+    )
+    assert seen == [("s0", 64), ("s64", 64), ("s128", 22)]
+    assert res.stats.padded_rows == 42
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+
+def test_batch_rows_and_partitions_env_overrides(monkeypatch):
+    assert score_batch_rows() == 8192
+    assert score_batch_rows(1024) == 1024
+    monkeypatch.setenv("PHOTON_SCORE_BATCH_ROWS", "256")
+    assert score_batch_rows(1024) == 256
+    assert score_output_partitions() == 1
+    monkeypatch.setenv("PHOTON_SCORE_PARTITIONS", "7")
+    assert score_output_partitions(3) == 7
+    monkeypatch.setenv("PHOTON_SCORE_BATCH_ROWS", "0")
+    with pytest.raises(ValueError):
+        score_batch_rows()
+
+
+# ---------------------------------------------------------------------------
+# sharded output round trip
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_output_round_trips_through_avro_reader(tmp_path):
+    from photon_tpu.io.avro import read_avro_dir
+    from photon_tpu.io.model_io import ShardedScoringWriter
+
+    model = _make_model()
+    data = _make_data(n=300)
+    scorer = GameScorer(model, batch_rows=64)
+    out = tmp_path / "scores"
+    writer = ShardedScoringWriter(out, num_partitions=3, model_id="m9")
+    res = scorer.stream(
+        (
+            slice_game_data(data, lo, min(lo + 64, 300))
+            for lo in range(0, 300, 64)
+        ),
+        on_batch=lambda c, s: writer.write_chunk(
+            s, labels=c.labels, weights=c.weights, uids=c.uids
+        ),
+    )
+    assert writer.close() == 300
+    parts = sorted(p.name for p in out.iterdir())
+    assert parts == ["part-00000.avro", "part-00001.avro", "part-00002.avro"]
+    records = list(read_avro_dir(out))
+    assert len(records) == 300
+    assert all(r["modelId"] == "m9" for r in records)
+    # round-robin sharding reorders rows across parts; uid joins them back
+    by_uid = {r["uid"]: r for r in records}
+    assert len(by_uid) == 300
+    for i in (0, 63, 64, 150, 299):
+        np.testing.assert_allclose(
+            by_uid[f"s{i}"]["predictionScore"], res.scores[i], rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            by_uid[f"s{i}"]["label"], data.labels[i], rtol=1e-6
+        )
+
+
+def test_avro_file_writer_matches_one_shot_writer(tmp_path):
+    from photon_tpu.io.avro import AvroFileWriter, read_avro_file, write_avro_file
+    from photon_tpu.io.schemas import SCORING_RESULT_AVRO
+
+    recs = [
+        {
+            "uid": f"r{i}",
+            "label": float(i),
+            "modelId": "m",
+            "predictionScore": float(i) / 7.0,
+            "weight": 1.0,
+            "metadataMap": None,
+        }
+        for i in range(10)
+    ]
+    p1, p2 = tmp_path / "a.avro", tmp_path / "b.avro"
+    write_avro_file(p1, SCORING_RESULT_AVRO, recs)
+    with AvroFileWriter(p2, SCORING_RESULT_AVRO) as w:
+        for i in range(0, 10, 3):  # several append calls, one container
+            w.append(recs[i : i + 3])
+    assert w.total == 10
+    assert read_avro_file(p1) == read_avro_file(p2)
+
+
+# ---------------------------------------------------------------------------
+# chunked reader + GameData slice/concat
+# ---------------------------------------------------------------------------
+
+
+def test_slice_concat_game_data_round_trip():
+    data = _make_data(n=97)
+    pieces = [
+        slice_game_data(data, lo, min(lo + 20, 97)) for lo in range(0, 97, 20)
+    ]
+    back = concat_game_data(pieces)
+    assert back.num_samples == 97
+    np.testing.assert_array_equal(back.labels, data.labels)
+    for name in ("g", "u"):
+        a, b = back.feature_shards[name], data.feature_shards[name]
+        np.testing.assert_array_equal(a.indptr, b.indptr)
+        np.testing.assert_array_equal(a.indices, b.indices)
+        np.testing.assert_array_equal(a.values, b.values)
+    np.testing.assert_array_equal(
+        back.id_tags["userId"], data.id_tags["userId"]
+    )
+    assert list(back.uids) == list(data.uids)
+
+
+def test_iter_chunks_spans_file_boundaries(tmp_path):
+    """Chunks must come out exactly chunk_rows-sized regardless of how
+    the input was split into part files (rows carry across files)."""
+    from photon_tpu.data.index_map import DefaultIndexMap, feature_key
+    from photon_tpu.io.avro import write_avro_file
+    from photon_tpu.io.data_reader import AvroDataReader, FeatureShardConfig
+    from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+    rng = np.random.default_rng(3)
+    n = 110
+    recs = [
+        {
+            "uid": f"s{i}",
+            "label": float(i % 2),
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(rng.normal())}
+                for j in range(4)
+            ],
+            "metadataMap": {"userId": f"u{i % 5}"},
+            "weight": 1.0,
+            "offset": 0.0,
+        }
+        for i in range(n)
+    ]
+    d = tmp_path / "in"
+    d.mkdir()
+    # uneven part files: 40 + 40 + 30
+    for p, (lo, hi) in enumerate([(0, 40), (40, 80), (80, 110)]):
+        write_avro_file(
+            d / f"part-{p:05d}.avro", TRAINING_EXAMPLE_AVRO, recs[lo:hi]
+        )
+    imap = DefaultIndexMap.from_keys(
+        [feature_key(f"f{j}") for j in range(4)], add_intercept=False
+    )
+    cfg = {"g": FeatureShardConfig(feature_bags=("features",), has_intercept=False)}
+    reader = AvroDataReader(index_maps={"g": imap})
+    chunks = list(
+        reader.iter_chunks(str(d), cfg, id_tags=("userId",), chunk_rows=32)
+    )
+    assert [c.num_samples for c in chunks] == [32, 32, 32, 14]
+    # order + content survive the reassembly
+    merged = concat_game_data(chunks)
+    assert list(merged.uids) == [f"s{i}" for i in range(n)]
+    full = reader.read(str(d), cfg, id_tags=("userId",))
+    np.testing.assert_array_equal(merged.labels, full.labels)
+    np.testing.assert_allclose(
+        merged.feature_shards["g"].values, full.feature_shards["g"].values
+    )
+
+    # chunked reads need index maps up front
+    with pytest.raises(ValueError, match="index maps"):
+        list(
+            AvroDataReader().iter_chunks(
+                str(d), cfg, id_tags=("userId",), chunk_rows=32
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# satellite: memoized entity→row index maps
+# ---------------------------------------------------------------------------
+
+
+def test_score_cold_does_not_rebuild_vocab_indices():
+    """Two scores must build each vocab index dict exactly once (the old
+    path rebuilt row/col dicts on every MatrixFactorizationModel
+    .score_cold call — photon_tpu/game/model.py)."""
+    import photon_tpu.game.model as model_mod
+
+    model = _make_model()
+    data = _make_data(n=50)
+    mf = model["mf"]
+    re = model["per-user"]
+    real = model_mod._build_vocab_index
+    with mock.patch.object(
+        model_mod, "_build_vocab_index", side_effect=real
+    ) as counted:
+        mf.score_cold(data)
+        mf.score_cold(data)
+        assert counted.call_count == 2  # row + col, once each
+        re.score_cold(data)
+        re.score_cold(data)
+        assert counted.call_count == 3  # +1 for the RE vocab, once
+    # the memo is shared with the streaming engine's host lookup
+    assert mf.row_index is mf.row_index
+    assert re.entity_row_index is re.entity_row_index
+
+
+# ---------------------------------------------------------------------------
+# bench quality bands for the scoring config
+# ---------------------------------------------------------------------------
+
+
+def test_scoring_quality_bands():
+    import bench
+
+    good = {"parity": {"max_rel_diff": 1e-7}, "steady_compiles": 0}
+    assert bench.check_quality_bands("game_scoring_stream", good) == []
+    divergent = {"parity": {"max_rel_diff": 0.5}, "steady_compiles": 0}
+    assert any(
+        "parity" in v
+        for v in bench.check_quality_bands("game_scoring_stream", divergent)
+    )
+    retracing = {"parity": {"max_rel_diff": 1e-7}, "steady_compiles": 3}
+    assert any(
+        "steady-state" in v
+        for v in bench.check_quality_bands("game_scoring_stream", retracing)
+    )
+    missing = {}
+    assert len(bench.check_quality_bands("game_scoring_stream", missing)) == 2
+
+
+def test_consumer_failure_reaps_producer_and_scorer_is_reusable():
+    """A failing sink must not leave the decode thread blocked on the
+    full hand-off queue holding decoded chunks — and the same scorer
+    must stream cleanly afterwards (staging stats reset)."""
+    import threading
+
+    model = _make_model()
+    data = _make_data(n=320)
+    scorer = GameScorer(model, batch_rows=64)
+
+    def chunks():
+        for lo in range(0, 320, 64):
+            yield slice_game_data(data, lo, lo + 64)
+
+    def bad_sink(chunk, scores):
+        raise RuntimeError("sink exploded")
+
+    with pytest.raises(RuntimeError, match="sink exploded"):
+        scorer.stream(chunks(), on_batch=bad_sink)
+    for _ in range(200):  # the reap is bounded, not instantaneous
+        if not any(
+            t.name == "score-decode" for t in threading.enumerate()
+        ):
+            break
+        time.sleep(0.01)
+    assert not any(t.name == "score-decode" for t in threading.enumerate())
+    res = scorer.stream(chunks())
+    assert res.stats.samples == 320
+    assert 1 <= res.stats.max_staged_chunks <= 2
+
+
+def test_sharded_writer_materializes_every_partition(tmp_path):
+    """Fewer batches than partitions must still produce num_partitions
+    part files (empty shards are valid zero-record containers) — a
+    consumer may glob for exactly that many."""
+    from photon_tpu.io.avro import read_avro_file
+    from photon_tpu.io.model_io import ShardedScoringWriter
+
+    out = tmp_path / "scores"
+    with ShardedScoringWriter(out, num_partitions=3, model_id="m") as w:
+        w.write_chunk(
+            np.array([0.5, 1.5]), labels=np.array([0.0, 1.0]),
+            uids=["a", "b"],
+        )
+    assert w.total == 2
+    parts = sorted(p.name for p in out.iterdir())
+    assert parts == [
+        "part-00000.avro", "part-00001.avro", "part-00002.avro"
+    ]
+    assert len(read_avro_file(out / "part-00000.avro")) == 2
+    assert read_avro_file(out / "part-00001.avro") == []
+    assert read_avro_file(out / "part-00002.avro") == []
+
+
+def test_sharded_writer_rejects_mixed_column_presence(tmp_path):
+    """close() concatenates per column, so a None chunk mixed with real
+    ones in the same partition would silently misalign labels/weights/
+    uids against scores — write_chunk must refuse the mix up front."""
+    from photon_tpu.io.model_io import ShardedScoringWriter
+
+    w = ShardedScoringWriter(tmp_path / "scores", num_partitions=1)
+    w.write_chunk(np.array([0.5]), labels=np.array([1.0]), uids=["a"])
+    with pytest.raises(ValueError, match="column presence"):
+        w.write_chunk(np.array([1.5]))
+    # consistent columns still flow
+    w.write_chunk(np.array([2.5]), labels=np.array([0.0]), uids=["b"])
+    assert w.close() == 2
+    # a write after close would buffer into a discarded dict — refuse
+    with pytest.raises(ValueError, match="closed"):
+        w.write_chunk(np.array([3.5]), labels=np.array([1.0]), uids=["c"])
+
+
+def test_unsupported_layout_error_is_distinct():
+    """Drivers fall back to monolithic scoring ONLY on
+    UnsupportedModelLayout; a bad knob value is a plain ValueError and
+    must raise instead of silently demoting the run."""
+    from photon_tpu.game.scoring import UnsupportedModelLayout
+
+    assert issubclass(UnsupportedModelLayout, ValueError)
+    model = _make_model()
+    with pytest.raises(UnsupportedModelLayout, match="dense gather limit"):
+        GameScorer(model, dense_cols_max=1)
+    with pytest.raises(ValueError) as ei:
+        GameScorer(model, batch_rows=0)
+    assert not isinstance(ei.value, UnsupportedModelLayout)
