@@ -1,0 +1,25 @@
+"""photon-lint: the device-discipline static-analysis suite.
+
+Two engines, one gate:
+
+* AST rules (stdlib ``ast``, no deps) over the package — each grounded
+  in a bug class this repo shipped: PHL001 donated-view aliasing (PR 2),
+  PHL002 host-sync in hot paths, PHL003 thread/queue lifecycles (PR 5),
+  PHL004 ctypes temporary-buffer pools (PR 3), PHL005 jit retrace
+  hazards, PHL006 wall-clock durations.
+* program checks (``analysis.hlo``) over lowered/compiled XLA modules:
+  collective-freedom, constant-embedding bounds, and the solve-shape
+  census against the PR 3 shape budget — runnable over every
+  AOT-precompiled executable of a fit, not just test fixtures.
+
+Run locally with ``python -m photon_tpu.analysis``; the catalog and the
+allowlist policy live in docs/DESIGN.md §Static analysis.
+"""
+from photon_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    analyze_source,
+    analyze_tree,
+    is_hot_path,
+)

@@ -1,0 +1,298 @@
+"""``python -m photon_tpu.analysis`` — the device-discipline gate.
+
+Walks the tree (package + scripts + bench.py), runs the PHL rules,
+applies the inline annotations and the reviewed baseline, and exits
+non-zero on anything NEW (exit 1) or on STALE baseline entries (exit 2)
+— both mean the committed state and the allowlist have drifted apart.
+``--jsonl`` emits every finding (including the suppressed ones, with
+their status) as one JSON object per line for the CI artifact.
+
+``--programs`` additionally runs the program checks (analysis/hlo.py)
+over every AOT-precompiled executable of a canonical two-coordinate
+GAME fixture — the generalization of the old two-test ``hlo-guards``
+job. It imports jax and pays a few seconds of XLA compiles, so it is
+opt-in; the AST pass stays dependency-light and sub-second.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+from photon_tpu.analysis.baseline import (
+    BaselineEntry,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from photon_tpu.analysis.core import all_rules, analyze_tree
+
+#: default note stamped on --write-baseline entries; reviewers replace it
+#: with the actual justification during sign-off
+_TODO_NOTE = "reviewed: intentional site (replace with justification)"
+
+
+def _find_root(start: Path) -> Path:
+    """The scan root: the nearest ancestor holding photon_tpu/."""
+    cur = start.resolve()
+    for cand in (cur, *cur.parents):
+        if (cand / "photon_tpu").is_dir():
+            return cand
+    return cur
+
+
+def build_canonical_fixture():
+    """A small two-coordinate (FE + RE) GAME build, precompiled — the
+    program-check corpus. Deliberately tiny: the value is in auditing
+    EVERY program the fit dispatches, not in scale."""
+    import numpy as np
+
+    from photon_tpu.game.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.game.coordinate import build_coordinate
+    from photon_tpu.game.data import (
+        CSRMatrix,
+        GameData,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.game.descent import precompile_coordinates
+    from photon_tpu.optimize.common import OptimizerConfig
+    from photon_tpu.optimize.problem import (
+        GLMProblemConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    n, fe_dim, users, d_re = 256, 32, 24, 6
+    ids = rng.integers(0, users, size=n)
+    data = GameData.build(
+        labels=(rng.uniform(size=n) < 0.5).astype(np.float64),
+        feature_shards={
+            "global": CSRMatrix.from_dense(
+                rng.normal(size=(n, fe_dim)).astype(np.float32)
+            ),
+            "per_user": CSRMatrix.from_dense(
+                rng.normal(size=(n, d_re)).astype(np.float32)
+            ),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=3),
+        regularization=RegularizationContext(RegularizationType.L2),
+    )
+    fe_cfg = FixedEffectCoordinateConfig(
+        feature_shard="global", optimization=opt,
+        regularization_weights=(1.0,),
+    )
+    re_cfg = RandomEffectCoordinateConfig(
+        random_effect_type="userId", feature_shard="per_user",
+        optimization=opt, regularization_weights=(1.0,),
+    )
+    coordinates = {
+        "global": build_coordinate(data, fe_cfg),
+        "per_user": build_coordinate(
+            data, re_cfg,
+            re_dataset=build_random_effect_dataset(data, re_cfg),
+        ),
+    }
+    precompile_coordinates(coordinates)
+    return coordinates
+
+
+def run_program_checks(jsonl_rows: list[dict]) -> int:
+    from photon_tpu.analysis.hlo import audit_coordinates
+    from photon_tpu.game.data import re_shape_budget
+
+    coordinates = build_canonical_fixture()
+    report = audit_coordinates(
+        coordinates, shape_budget=re_shape_budget(None)
+    )
+    print(
+        f"[photon-lint] program checks: {report.programs_checked} "
+        f"precompiled executables audited, "
+        f"{len(report.census)} distinct solve shapes"
+    )
+    for pf in report.findings:
+        print(f"  {pf.render()}")
+        jsonl_rows.append({"engine": "hlo", **pf.to_json()})
+    if report.programs_checked == 0:
+        print("[photon-lint] ERROR: precompile produced no executables")
+        return 1
+    return 1 if report.findings else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m photon_tpu.analysis",
+        description="photon-lint: device-discipline static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to scan (default: photon_tpu/, scripts/, bench.py "
+        "under --root)",
+    )
+    parser.add_argument(
+        "--root", type=Path, default=None,
+        help="scan root (default: nearest ancestor of cwd with photon_tpu/)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help="allowlist file (default: <root>/photon_tpu/analysis/"
+        "baseline.toml)",
+    )
+    parser.add_argument(
+        "--jsonl", type=Path, default=None,
+        help="write every finding as JSONL to this path",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from current unsuppressed findings "
+        "(requires review — every entry is a sign-off)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--programs", action="store_true",
+        help="also audit every AOT-precompiled executable of the "
+        "canonical fixture (imports jax, compiles)",
+    )
+    parser.add_argument(
+        "--show-allowed", action="store_true",
+        help="also print baseline/annotated findings",
+    )
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            scope = "hot-path modules" if r.hot_path_only else "whole tree"
+            print(f"{r.rule_id}  [{scope}]  {r.title}")
+        return 0
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        unknown = wanted - {r.rule_id for r in rules}
+        if unknown:
+            parser.error(f"unknown rule id(s): {sorted(unknown)}")
+        rules = [r for r in rules if r.rule_id in wanted]
+
+    root = args.root if args.root is not None else _find_root(Path.cwd())
+    root = root.resolve()
+    baseline_path = (
+        args.baseline
+        if args.baseline is not None
+        else root / "photon_tpu" / "analysis" / "baseline.toml"
+    )
+    files = None
+    if args.paths:
+        files = []
+        for p in args.paths:
+            p = Path(p).resolve()
+            if p.is_dir():
+                files.extend(
+                    f
+                    for f in sorted(p.rglob("*.py"))
+                    if "__pycache__" not in f.parts
+                )
+            else:
+                files.append(p)
+
+    findings = analyze_tree(root, files, rules=rules)
+
+    if args.write_baseline:
+        if args.paths or args.rules:
+            # a partial scan sees a subset of findings — rewriting the
+            # whole allowlist from it would silently drop (and lose the
+            # reviewed notes of) every entry outside the subset
+            parser.error(
+                "--write-baseline requires a full default scan; drop the "
+                "explicit paths / --rules filter"
+            )
+        entries = [
+            BaselineEntry(
+                rule=f.rule, path=f.path, snippet=f.snippet, note=_TODO_NOTE
+            )
+            for f in findings
+            # PHL000 (parse failure) is an analyzer error, never an
+            # intentional site: baselining it would permanently blind
+            # every other rule to that file
+            if f.status != "annotated" and f.rule != "PHL000"
+        ]
+        write_baseline(baseline_path, set(entries))
+        print(
+            f"[photon-lint] wrote {len(set(entries))} entries to "
+            f"{baseline_path} — review the diff before committing"
+        )
+        return 0
+
+    entries = load_baseline(baseline_path)
+    if files is not None:
+        # partial scan: an entry for a file outside the scan set is not
+        # evidence of drift — staleness is only decidable for files we
+        # actually analyzed
+        scanned = {
+            f.resolve().relative_to(root).as_posix()
+            for f in files
+            if f.resolve().is_relative_to(root)
+        }
+        entries = [e for e in entries if e.path in scanned]
+    gate = apply_baseline(findings, entries)
+
+    jsonl_rows = [
+        {"engine": "ast", **f.to_json()}
+        for f in [*gate.new, *gate.allowed, *gate.annotated]
+    ]
+
+    for f in gate.new:
+        print(f.render())
+    if args.show_allowed:
+        for f in [*gate.allowed, *gate.annotated]:
+            print(f"[{f.status}] {f.render()}")
+    for e in gate.stale:
+        print(f"STALE baseline entry (no matching finding): {e.render()}")
+
+    rc = 0
+    if gate.new:
+        rc = 1
+    elif gate.stale:
+        rc = 2
+
+    if args.programs:
+        prc = run_program_checks(jsonl_rows)
+        rc = rc or prc
+
+    if args.jsonl:
+        args.jsonl.parent.mkdir(parents=True, exist_ok=True)
+        with open(args.jsonl, "w", encoding="utf-8") as fh:
+            for row in jsonl_rows:
+                fh.write(json.dumps(row) + "\n")
+
+    counts = Counter(f.rule for f in gate.new)
+    summary = (
+        ", ".join(f"{r}×{n}" for r, n in sorted(counts.items()))
+        if counts
+        else "none"
+    )
+    print(
+        f"[photon-lint] scanned under {root}: new findings: {summary}; "
+        f"{len(gate.allowed)} baseline-allowed, {len(gate.annotated)} "
+        f"annotated, {len(gate.stale)} stale baseline entries "
+        f"-> {'PASS' if rc == 0 else f'FAIL (exit {rc})'}"
+    )
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

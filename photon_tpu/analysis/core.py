@@ -1,0 +1,316 @@
+"""photon-lint core: findings, inline annotations, rule registry, tree walk.
+
+The AST engine of the device-discipline suite (``python -m
+photon_tpu.analysis``). Each rule is grounded in a bug this repo actually
+shipped (see docs/DESIGN.md §Static analysis for the catalog and
+provenance); rules are deliberately mechanical — a pattern either matches
+or it doesn't — and the escape hatches are explicit and reviewable:
+
+* an inline annotation ``# phl-ok: PHL00X <reason>`` on the finding line
+  (or the line directly above) marks an INTENTIONAL site, e.g. the one
+  read-back barrier per sweep. The reason text is mandatory — a bare
+  annotation does not suppress.
+* ``analysis/baseline.toml`` carries the reviewed long tail of existing
+  sites. Baseline entries match on (rule, path, stripped source line), so
+  they survive line-number drift but die with the code they describe —
+  the stale-allowlist test fails when an entry no longer resolves.
+
+Findings never crash the analyzer: a file that does not parse is reported
+as a PHL000 finding instead.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Callable, Iterable, Sequence
+
+#: modules whose steady-state loops the perf PRs made sync-free /
+#: donation-safe — PHL001/PHL002 fire only here (relative posix paths or
+#: directory prefixes under the scan root)
+HOT_PATH_FILES = (
+    "photon_tpu/game/coordinate.py",
+    "photon_tpu/game/descent.py",
+    "photon_tpu/game/scoring.py",
+)
+HOT_PATH_PREFIXES = ("photon_tpu/optimize/",)
+
+_ANNOTATION_RE = re.compile(
+    r"#\s*phl-ok:\s*(?P<rules>PHL\d{3}(?:\s*,\s*PHL\d{3})*)\s*(?P<reason>\S.*)?$"
+)
+
+
+def is_hot_path(relpath: str) -> bool:
+    p = relpath.replace("\\", "/")
+    return p in HOT_PATH_FILES or any(
+        p.startswith(pref) for pref in HOT_PATH_PREFIXES
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # scan-root-relative posix path
+    line: int
+    col: int
+    message: str
+    #: the stripped source line — the line-number-independent fingerprint
+    #: baseline entries match against
+    snippet: str
+    #: "new" | "annotated" | "baseline" — set by the gate, not the rules
+    status: str = "new"
+
+    def with_status(self, status: str) -> "Finding":
+        return dataclasses.replace(self, status=status)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"{self.message}\n    {self.snippet}"
+        )
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule sees for one file."""
+
+    path: str
+    tree: ast.Module
+    lines: list[str]
+    hot: bool
+    #: line → set of rule ids suppressed by a reasoned ``# phl-ok:``
+    annotations: dict[int, set[str]]
+    #: node-id set shared between cooperating rules (PHL001 claims
+    #: escaping np.asarray nodes so PHL002 doesn't double-report them)
+    claimed: set[int] = dataclasses.field(default_factory=set)
+    #: ast parent links, built lazily
+    _parents: dict[int, ast.AST] | None = None
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            snippet=self.snippet(line),
+        )
+
+    def parents(self) -> dict[int, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[id(child)] = parent
+        return self._parents
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self.parents().get(id(node))
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    def is_suppressed(self, f: Finding) -> bool:
+        for line in (f.line, f.line - 1):
+            if f.rule in self.annotations.get(line, set()):
+                return True
+        return False
+
+
+class Rule:
+    """One PHL rule. Subclasses set the id/title and implement check()."""
+
+    rule_id: str = "PHL000"
+    title: str = ""
+    hot_path_only: bool = False
+
+    def check(self, ctx: FileContext) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def parse_annotations(src: str) -> dict[int, set[str]]:
+    """``# phl-ok: PHL002 <reason>`` COMMENTS, keyed by 1-based line —
+    real comments only, via tokenize, so the marker inside a string
+    literal (a log message, a rule's own help text) cannot suppress
+    anything. Annotations without a reason are ignored (the finding
+    still fires) — the reason is the reviewable artifact."""
+    out: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ANNOTATION_RE.search(tok.string)
+            if m is None or not m.group("reason"):
+                continue
+            out[tok.start[0]] = {
+                r.strip() for r in m.group("rules").split(",")
+            }
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        pass  # ast.parse already succeeded, so this is unreachable
+    return out
+
+
+# --- name-resolution helpers shared by the rule modules -------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'np.asarray' for Attribute chains over Names, else None."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def root_name(node: ast.AST) -> str | None:
+    """The leftmost Name of an Attribute/Subscript/Call chain."""
+    cur = node
+    while True:
+        if isinstance(cur, ast.Name):
+            return cur.id
+        if isinstance(cur, (ast.Attribute, ast.Subscript, ast.Starred)):
+            cur = cur.value
+        elif isinstance(cur, ast.Call):
+            cur = cur.func
+        else:
+            return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# --- engine ---------------------------------------------------------------
+
+_REGISTRY: list[Rule] = []
+
+
+def register(rule_cls: type) -> type:
+    _REGISTRY.append(rule_cls())
+    return rule_cls
+
+
+def all_rules() -> list[Rule]:
+    # import for side effect: rule modules self-register
+    from photon_tpu.analysis import (  # noqa: F401
+        rules_ctypes,
+        rules_host_sync,
+        rules_jit,
+        rules_threads,
+    )
+
+    return sorted(_REGISTRY, key=lambda r: r.rule_id)
+
+
+def analyze_source(
+    src: str,
+    path: str,
+    *,
+    hot: bool | None = None,
+    rules: Iterable[Rule] | None = None,
+) -> list[Finding]:
+    """Run the AST rules over one file's source. Annotated findings are
+    returned with status="annotated"; callers decide whether those gate.
+    ``hot=None`` classifies from the path (tests force it for fixtures)."""
+    relpath = path.replace("\\", "/")
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=relpath)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="PHL000",
+                path=relpath,
+                line=e.lineno or 1,
+                col=(e.offset or 0) + 1,
+                message=f"file does not parse: {e.msg}",
+                snippet=lines[(e.lineno or 1) - 1].strip() if lines else "",
+            )
+        ]
+    ctx = FileContext(
+        path=relpath,
+        tree=tree,
+        lines=lines,
+        hot=is_hot_path(relpath) if hot is None else hot,
+        annotations=parse_annotations(src),
+    )
+    findings: list[Finding] = []
+    for rule in rules if rules is not None else all_rules():
+        if rule.hot_path_only and not ctx.hot:
+            continue
+        for f in rule.check(ctx):
+            findings.append(
+                f.with_status("annotated") if ctx.is_suppressed(f) else f
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def default_scan_files(root: Path) -> list[Path]:
+    """The tree the gate walks: the package, the scripts, and bench.py.
+    Tests are excluded on purpose — test code plants these patterns."""
+    out: list[Path] = []
+    for sub in ("photon_tpu", "scripts"):
+        base = root / sub
+        if base.is_dir():
+            out.extend(
+                p
+                for p in sorted(base.rglob("*.py"))
+                if "__pycache__" not in p.parts
+            )
+    bench = root / "bench.py"
+    if bench.is_file():
+        out.append(bench)
+    return out
+
+
+def analyze_tree(
+    root: Path,
+    files: Sequence[Path] | None = None,
+    *,
+    rules: Iterable[Rule] | None = None,
+    on_file: Callable[[Path], None] | None = None,
+) -> list[Finding]:
+    root = Path(root)
+    findings: list[Finding] = []
+    rules = list(rules) if rules is not None else all_rules()
+    for p in files if files is not None else default_scan_files(root):
+        if on_file is not None:
+            on_file(p)
+        try:
+            rel = p.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:  # explicit path outside the scan root
+            rel = p.as_posix()
+        findings.extend(
+            analyze_source(p.read_text(encoding="utf-8"), rel, rules=rules)
+        )
+    return findings

@@ -1,0 +1,300 @@
+"""Program checks: reusable passes over lowered/compiled XLA modules.
+
+Generalizes the two one-off hlo-guard tests into passes any caller can
+run over ANY module — in particular over every AOT-precompiled
+executable of a fit (``audit_coordinates``), not just two hand-picked
+fixtures:
+
+* **collective-freedom** (PERF.md r5): the random-effect solves are
+  per-entity independent by construction; a cross-device collective in
+  one is pure overhead on real ICI and fatal straggle on the virtual
+  CPU mesh.
+* **constant-embedding bound** (PERF.md r4): closed-over arrays lower as
+  HLO literal constants serialized INTO the module — observed as
+  HTTP-413 rejections and multi-minute hangs at the remote compile
+  service. Data rides as arguments; anything over a scalar-ish epsilon
+  embedded in the module is a bug.
+* **solve-shape census** (PERF.md r6): the PR 3 shape budget bounds the
+  fit's TOTAL distinct (rows, d) solve shapes; the census counts what a
+  built fit will actually compile and compares.
+
+The passes take compiled executables, ``jax.stages.Lowered`` objects, or
+raw module text, and cover both the post-optimization HLO dialect
+(``f32[64,128]{1,0} constant(...)``, ``all-reduce``) and StableHLO
+(``stablehlo.constant dense<...> : tensor<64x128xf32>``,
+``stablehlo.all_reduce``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Iterable, Mapping
+
+import numpy as np
+
+#: anything bigger than this many bytes embedded in a program is a data
+#: array smuggled through a closure, not a tolerable scalar table
+DEFAULT_CONST_BYTES_LIMIT = 16 * 1024
+
+_COLLECTIVE_RE = re.compile(
+    r"all-reduce|all-gather|all-to-all|collective-\w+|reduce-scatter"
+    r"|stablehlo\.all_reduce|stablehlo\.all_gather|stablehlo\.all_to_all"
+    r"|stablehlo\.collective_\w+|stablehlo\.reduce_scatter"
+)
+
+# `f32[64,128]{1,0} constant(` — post-optimization HLO
+_HLO_CONST_RE = re.compile(
+    r"\b(?P<dtype>pred|[fsu]\d+|bf16|c64|c128)\[(?P<dims>[0-9,]*)\]"
+    r"(?:\{[^}]*\})?\s+constant\("
+)
+# `stablehlo.constant dense<...> : tensor<64x128xf32>` — StableHLO
+_SHLO_CONST_RE = re.compile(
+    r"stablehlo\.constant\s+dense<[^:]*:\s*tensor<(?P<sig>[0-9x]*x?"
+    r"(?P<dtype>pred|[fsu]\d+|bf16|i\d+|ui\d+))>"
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "bf16": 2, "c64": 8, "c128": 16,
+}
+
+
+def _dtype_bytes(name: str) -> int:
+    if name in _DTYPE_BYTES:
+        return _DTYPE_BYTES[name]
+    m = re.fullmatch(r"[fsu]?i?u?\w*?(\d+)", name)
+    return max(1, int(m.group(1)) // 8) if m else 4
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramFinding:
+    """One violated program contract (the HLO analogue of a Finding)."""
+
+    check: str  # "no-collectives" | "const-embedding" | "shape-budget"
+    program: str  # human label, e.g. "per_user:sweep"
+    message: str
+
+    def render(self) -> str:
+        return f"[{self.check}] {self.program}: {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def module_text(obj) -> str:
+    """Module text from a Compiled/Lowered/str."""
+    if isinstance(obj, str):
+        return obj
+    as_text = getattr(obj, "as_text", None)
+    if as_text is not None:
+        return as_text()
+    raise TypeError(
+        f"cannot extract module text from {type(obj).__name__}; pass a "
+        "Lowered, a Compiled, or str"
+    )
+
+
+# --- collective freedom ---------------------------------------------------
+
+
+def find_collectives(text: str) -> list[str]:
+    return sorted(set(_COLLECTIVE_RE.findall(text)))
+
+
+def check_no_collectives(obj, program: str) -> list[ProgramFinding]:
+    collectives = find_collectives(module_text(obj))
+    if not collectives:
+        return []
+    return [
+        ProgramFinding(
+            check="no-collectives",
+            program=program,
+            message=(
+                f"lowered cross-device collectives {collectives} — the "
+                f"per-shard-independent solve contract is broken "
+                f"(PERF.md r5: overhead on ICI, fatal straggle on the "
+                f"virtual mesh)"
+            ),
+        )
+    ]
+
+
+# --- constant embedding ---------------------------------------------------
+
+
+def collect_jaxpr_consts(closed_jaxpr, out: list) -> None:
+    """Consts of this jaxpr AND of every nested ClosedJaxpr: a jitted
+    callee's closure constants live on the inner pjit equation's jaxpr —
+    the outer ``make_jaxpr`` consts list stays empty, so a non-recursive
+    check is vacuous for exactly the functions the guard protects."""
+    out.extend(closed_jaxpr.consts)
+    for eqn in closed_jaxpr.jaxpr.eqns:
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr") and hasattr(v, "consts"):  # ClosedJaxpr
+                collect_jaxpr_consts(v, out)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                        collect_jaxpr_consts(item, out)
+
+
+def check_jaxpr_const_embedding(
+    closed_jaxpr, program: str, limit: int = DEFAULT_CONST_BYTES_LIMIT
+) -> list[ProgramFinding]:
+    """Trace-level pass (pre-lowering): closure constants by array size."""
+    consts: list = []
+    collect_jaxpr_consts(closed_jaxpr, consts)
+    offenders = [
+        (int(np.asarray(c).nbytes), getattr(c, "shape", None))
+        for c in consts
+        if hasattr(c, "nbytes") and np.asarray(c).nbytes > limit
+    ]
+    if not offenders:
+        return []
+    return [
+        ProgramFinding(
+            check="const-embedding",
+            program=program,
+            message=(
+                f"traced program embeds {offenders} as constants — pass "
+                f"the data as jit arguments (HTTP-413 / remote-compile "
+                f"hang class, PERF.md r4)"
+            ),
+        )
+    ]
+
+
+def find_large_constants(
+    text: str, limit: int = DEFAULT_CONST_BYTES_LIMIT
+) -> list[tuple[str, int]]:
+    """(shape signature, nbytes) of every embedded literal over ``limit``
+    in HLO or StableHLO module text."""
+    out: list[tuple[str, int]] = []
+    for m in _HLO_CONST_RE.finditer(text):
+        dims = [int(d) for d in m.group("dims").split(",") if d]
+        nbytes = math.prod(dims) * _dtype_bytes(m.group("dtype"))
+        if nbytes > limit:
+            out.append((f"{m.group('dtype')}[{m.group('dims')}]", nbytes))
+    for m in _SHLO_CONST_RE.finditer(text):
+        sig = m.group("sig")
+        dims = [int(d) for d in sig.split("x")[:-1] if d.isdigit()]
+        nbytes = math.prod(dims) * _dtype_bytes(m.group("dtype"))
+        if nbytes > limit:
+            out.append((f"tensor<{sig}>", nbytes))
+    return out
+
+
+def check_const_embedding(
+    obj, program: str, limit: int = DEFAULT_CONST_BYTES_LIMIT
+) -> list[ProgramFinding]:
+    offenders = find_large_constants(module_text(obj), limit)
+    if not offenders:
+        return []
+    return [
+        ProgramFinding(
+            check="const-embedding",
+            program=program,
+            message=(
+                f"module embeds literal constants {offenders} (> {limit} "
+                f"bytes) — data must ride as program arguments (HTTP-413 "
+                f"/ remote-compile hang class, PERF.md r4)"
+            ),
+        )
+    ]
+
+
+# --- solve-shape census ---------------------------------------------------
+
+
+def solve_shape_census(coordinates: Mapping) -> set[tuple[int, int]]:
+    """Distinct (active_rows, d) solve shapes a built fit will compile,
+    read off the device buckets of every random-effect coordinate —
+    the same quantity the PR 3 shape budget bounds."""
+    shapes: set[tuple[int, int]] = set()
+    for coord in coordinates.values():
+        for db in getattr(coord, "device_buckets", None) or []:
+            f = db.features
+            if getattr(f, "ndim", 0) == 3:  # [E, n_act, d]
+                shapes.add((int(f.shape[1]), int(f.shape[2])))
+    return shapes
+
+
+def check_shape_budget(
+    coordinates: Mapping, budget: int | None
+) -> list[ProgramFinding]:
+    """Census vs the PR 3 budget: the fit's TOTAL distinct solve shapes
+    must not exceed it (None/0 = budget disabled, census-only)."""
+    census = solve_shape_census(coordinates)
+    if not budget or len(census) <= budget:
+        return []
+    return [
+        ProgramFinding(
+            check="shape-budget",
+            program="<fit>",
+            message=(
+                f"{len(census)} distinct solve shapes exceed the shape "
+                f"budget of {budget}: {sorted(census)} — the bucket DP "
+                f"(game/data._optimal_row_levels) is being bypassed or "
+                f"the budget is not threaded (PERF.md r6 compile bill)"
+            ),
+        )
+    ]
+
+
+# --- whole-fit audit ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AuditReport:
+    programs_checked: int
+    findings: list[ProgramFinding]
+    census: set[tuple[int, int]]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def audit_coordinates(
+    coordinates: Mapping,
+    *,
+    const_bytes_limit: int = DEFAULT_CONST_BYTES_LIMIT,
+    shape_budget: int | None = None,
+    collective_free: Iterable[str] | None = None,
+) -> AuditReport:
+    """Run every program pass over every AOT-precompiled executable of
+    the given coordinates (run ``descent.precompile_coordinates`` first —
+    the executables this audits are exactly the ones a fit dispatches).
+
+    Collective-freedom applies to random-effect coordinates by default
+    (their solves are per-entity independent; a sharded FE matvec may
+    legitimately reduce) — pass ``collective_free`` to name coordinates
+    explicitly. The constant-embedding bound applies to every program.
+    """
+    findings: list[ProgramFinding] = []
+    programs = 0
+    # materialize once: a one-shot iterable consumed inside the loop
+    # would silently skip the collectives check from coordinate 2 on
+    cf_names = None if collective_free is None else set(collective_free)
+    for cid, coord in coordinates.items():
+        re_like = (
+            cid in cf_names
+            if cf_names is not None
+            else "RandomEffect" in type(coord).__name__
+        )
+        executables = coord.aot_executables() or {}
+        for key in sorted(executables, key=repr):
+            label = f"{cid}:{':'.join(str(k) for k in key)}"
+            text = module_text(executables[key])
+            programs += 1
+            if re_like:
+                findings.extend(check_no_collectives(text, label))
+            findings.extend(
+                check_const_embedding(text, label, const_bytes_limit)
+            )
+    findings.extend(check_shape_budget(coordinates, shape_budget))
+    return AuditReport(
+        programs_checked=programs,
+        findings=findings,
+        census=solve_shape_census(coordinates),
+    )

@@ -1,0 +1,225 @@
+"""PHL001/PHL002/PHL006 — host/device boundary discipline.
+
+PHL001 is the PR 2 checkpoint-corruption class: ``np.asarray`` of a jax
+array on XLA:CPU is a ZERO-COPY view of the device buffer; if that view
+escapes the function (returned, stored on self, handed to a callback)
+and the buffer is later donated to a fused sweep program, the "snapshot"
+silently mutates in place. The descent sweep_callback shipped exactly
+this bug — checkpoints written from the callback tracked the live
+buffers instead of the sweep they claimed to record.
+
+PHL002 is the silent host-sync class the Spark-ML performance literature
+(PAPERS.md, Understanding and Optimizing Distributed ML on Spark) calls
+out as the dominant regression source: a ``float()``/``.item()``/
+``np.asarray``/``block_until_ready`` in a hot-path module forces a
+device→host round trip that serializes the dispatch pipeline. The PR 2
+contract is ONE read-back barrier per sweep; every other sync in a
+hot-path module is either build/teardown-time (baseline) or an
+explicitly annotated barrier site (``# phl-ok: PHL002 <reason>``).
+
+PHL006 is the obs-spine clock mandate: ``time.time()`` is not monotonic
+(NTP steps it backwards), so durations and deadlines computed from it
+are wrong exactly when clocks are being corrected. Only epoch ANCHORS
+(one wall-clock capture aligned to a monotonic base) may use it, and
+those sites carry an annotation.
+"""
+from __future__ import annotations
+
+import ast
+
+from photon_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    keyword_arg,
+    register,
+)
+
+_NP_VIEW_CALLS = {"np.asarray", "numpy.asarray"}
+# np.array is NOT here: it copies by default, which makes it a declared
+# snapshot (the same reason a .copy() chain is exempt below)
+_NP_SYNC_CALLS = {
+    "np.asarray", "numpy.asarray", "jax.device_get",
+}
+#: attribute methods that turn the asarray result into a copy or a host
+#: scalar before it can alias the device buffer
+_SAFE_CHAIN_ATTRS = {
+    "copy", "astype", "tolist", "item", "sum", "mean", "min", "max",
+    "nbytes", "shape", "dtype",
+}
+
+
+def _is_copy_true(call: ast.Call) -> bool:
+    """Only a literal copy=True is a declared snapshot — copy=False is
+    an explicitly REQUESTED view (the sharpest form of the hazard), and
+    a dynamic value proves nothing."""
+    kw = keyword_arg(call, "copy")
+    return isinstance(kw, ast.Constant) and kw.value is True
+
+
+def _is_view_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and call_name(node) in _NP_VIEW_CALLS
+        and not _is_copy_true(node)
+    )
+
+
+@register
+class DonatedViewEscape(Rule):
+    rule_id = "PHL001"
+    title = "numpy view of a device buffer escapes without .copy()"
+    hot_path_only = True
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not _is_view_call(node):
+                continue
+            escape = self._escape_context(ctx, node)
+            if escape is None:
+                continue
+            ctx.claimed.add(id(node))
+            out.append(
+                ctx.finding(
+                    self.rule_id,
+                    node,
+                    f"np.asarray view of a (possibly donated) device "
+                    f"buffer escapes this function ({escape}) without "
+                    f".copy() — on XLA:CPU this aliases the live buffer "
+                    f"and mutates under later donated dispatches (the "
+                    f"PR 2 checkpoint corruption); snapshot with "
+                    f"np.array(..., copy=True) or .copy()",
+                )
+            )
+        return out
+
+    def _escape_context(self, ctx: FileContext, node: ast.Call) -> str | None:
+        """Name of the escape route, or None when the view stays local /
+        is immediately copied."""
+        child: ast.AST = node
+        parent = ctx.parent(node)
+        # walk through view-preserving wrappers: subscripts/slices still
+        # alias the same memory, and containers (a list of views handed
+        # to a callback — the literal PR 2 shape) carry their elements
+        while isinstance(
+            parent,
+            (ast.Subscript, ast.Slice, ast.List, ast.Tuple, ast.Set,
+             ast.Dict, ast.Starred, ast.ListComp, ast.SetComp,
+             ast.DictComp, ast.GeneratorExp),
+        ):
+            child, parent = parent, ctx.parent(parent)
+        if isinstance(parent, ast.Attribute):
+            # np.asarray(x).copy() / .astype(...) / scalar reads — safe
+            if parent.attr in _SAFE_CHAIN_ATTRS:
+                return None
+            parent = ctx.parent(parent)
+        if isinstance(parent, (ast.Return, ast.Yield)):
+            return "returned"
+        if isinstance(parent, ast.Call) and child is not parent.func:
+            return "passed to a call"
+        if isinstance(parent, ast.keyword):
+            return "passed to a call"
+        if isinstance(parent, ast.Assign):
+            for tgt in parent.targets:
+                if isinstance(tgt, ast.Attribute):
+                    return "stored on an attribute"
+        return None
+
+
+@register
+class HostSyncInHotPath(Rule):
+    rule_id = "PHL002"
+    title = "host-sync call in a hot-path module outside a barrier site"
+    hot_path_only = True
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if id(node) in ctx.claimed:  # PHL001 already reported it
+                continue
+            msg = self._sync_kind(ctx, node)
+            if msg is not None:
+                out.append(ctx.finding(self.rule_id, node, msg))
+        return out
+
+    def _sync_kind(self, ctx: FileContext, node: ast.Call) -> str | None:
+        name = call_name(node)
+        if name in _NP_SYNC_CALLS:
+            # an explicit copy (`np.asarray(x).copy()`, `.astype(...)`,
+            # `copy=True`) is a DECLARED snapshot — the author already
+            # said "I am pulling this to the host on purpose", and it is
+            # exactly the remediation PHL001 prescribes; flagging it
+            # would make the two rules contradict each other
+            if _is_copy_true(node):
+                return None
+            parent = ctx.parent(node)
+            while isinstance(parent, (ast.Subscript, ast.Slice)):
+                parent = ctx.parent(parent)
+            if isinstance(parent, ast.Attribute) and parent.attr in (
+                "copy", "astype",
+            ):
+                return None
+            return (
+                f"{name}() materializes device data on the host (a "
+                f"device→host sync when the argument is a jax array) — "
+                f"hot paths stay on device; annotate genuine barrier "
+                f"sites with '# phl-ok: PHL002 <reason>'"
+            )
+        if name in ("jax.block_until_ready", "block_until_ready"):
+            return (
+                "block_until_ready stalls the dispatch pipeline — the "
+                "contract is one read-back barrier per sweep/stream step"
+            )
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "block_until_ready":
+                return (
+                    ".block_until_ready() stalls the dispatch pipeline — "
+                    "the contract is one read-back barrier per sweep"
+                )
+            if node.func.attr == "item" and not node.args:
+                return (
+                    ".item() forces a device→host read-back of one "
+                    "scalar — batch reads behind the per-sweep barrier"
+                )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id == "float"
+            and node.args
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            return (
+                "float(...) on a non-literal forces a device→host sync "
+                "when the value is a jax scalar — keep scalars on device "
+                "or read them behind the per-sweep barrier"
+            )
+        return None
+
+
+@register
+class WallClockDuration(Rule):
+    rule_id = "PHL006"
+    title = "time.time() used where a monotonic clock is mandated"
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and call_name(node) == "time.time"
+            ):
+                out.append(
+                    ctx.finding(
+                        self.rule_id,
+                        node,
+                        "time.time() is not monotonic — durations and "
+                        "deadlines must use time.monotonic()/"
+                        "time.perf_counter() (obs clock mandate); a "
+                        "genuine epoch anchor needs '# phl-ok: PHL006 "
+                        "<reason>'",
+                    )
+                )
+        return out

@@ -1,0 +1,193 @@
+"""PHL005 — retrace hazards in jit-decorated functions.
+
+Zero steady-state retraces is a load-bearing invariant here (PR 3's
+compile-bill governance, PR 5's zero-steady-retrace scoring band). Two
+mechanical ways to lose it from inside a ``@jit`` function:
+
+* Python-level branching on a traced argument (``if mask:``,
+  ``while err > tol:``): at best a ConcretizationTypeError at trace
+  time, at worst — when the operand is a weakly-typed scalar the caller
+  sometimes passes as a Python number — a silent retrace per distinct
+  value. Branch with ``lax.cond``/``jnp.where``; structure checks
+  (``x is None``) are static and stay exempt.
+* a static argument with a non-hashable default (list/dict/set):
+  ``jit`` hashes static args for the cache key, so the first call that
+  uses the default raises — or, when a caller passes a fresh list each
+  call, every call misses the cache and recompiles.
+
+Scope: functions whose decorator is visibly ``jit``/``jax.jit``/
+``pjit`` or ``partial(jax.jit, ...)``. Programs built by calling
+``jax.jit(fn)`` at runtime are covered by the program checks
+(analysis/hlo.py), not this AST rule.
+"""
+from __future__ import annotations
+
+import ast
+
+from photon_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit", "pjit.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _jit_decorator(dec: ast.expr) -> ast.Call | None:
+    """The decorator's configuring Call when this is a jit decorator
+    (None for bare ``@jax.jit``-style names)."""
+    if dotted_name(dec) in _JIT_NAMES:
+        return None
+    if isinstance(dec, ast.Call):
+        name = dotted_name(dec.func)
+        if name in _JIT_NAMES:
+            return dec
+        if name in _PARTIAL_NAMES and dec.args:
+            if dotted_name(dec.args[0]) in _JIT_NAMES:
+                return dec
+    return None
+
+
+def _is_jit_decorated(fn: ast.FunctionDef) -> tuple[bool, ast.Call | None]:
+    for dec in fn.decorator_list:
+        if dotted_name(dec) in _JIT_NAMES:
+            return True, None
+        call = _jit_decorator(dec)
+        if call is not None:
+            return True, call
+    return False, None
+
+
+def _static_params(fn: ast.FunctionDef, call: ast.Call | None) -> set[str]:
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: set[str] = set()
+    if call is None:
+        return static
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    static.add(v.value)
+        elif kw.arg == "static_argnums":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    if 0 <= v.value < len(params):
+                        static.add(params[v.value])
+    return static
+
+
+def _traced_names_in_test(test: ast.expr, traced: set[str]) -> list[ast.expr]:
+    """Sub-expressions of a branch condition that read a traced parameter
+    in a value (not structure) position."""
+    hits: list[ast.expr] = []
+
+    def visit(node: ast.expr) -> None:
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                visit(v)
+        elif isinstance(node, ast.UnaryOp):
+            visit(node.operand)
+        elif isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` test pytree STRUCTURE — that
+            # is static under jit and the idiomatic optional-arg check
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return
+            for operand in [node.left, *node.comparators]:
+                visit(operand)
+        elif isinstance(node, ast.Name):
+            if node.id in traced:
+                hits.append(node)
+        elif isinstance(node, ast.Call):
+            # mask.any() / x.all() / bool(x) on a traced root
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in (
+                "any", "all",
+            ):
+                visit(func.value)
+            elif isinstance(func, ast.Name) and func.id == "bool":
+                for a in node.args:
+                    visit(a)
+        elif isinstance(node, (ast.Attribute, ast.Subscript)):
+            # attribute/element reads keep tracer-ness EXCEPT .shape/
+            # .ndim/.dtype/.size, which are static metadata
+            if isinstance(node, ast.Attribute) and node.attr in (
+                "shape", "ndim", "dtype", "size",
+            ):
+                return
+            visit(node.value)
+        elif isinstance(node, ast.BinOp):
+            visit(node.left)
+            visit(node.right)
+
+    visit(test)
+    return hits
+
+
+@register
+class JitRetraceHazard(Rule):
+    rule_id = "PHL005"
+    title = "Python branching on traced args / non-hashable static args"
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            jitted, call = _is_jit_decorated(fn)
+            if not jitted:
+                continue
+            static = _static_params(fn, call)
+            params = {
+                a.arg for a in fn.args.posonlyargs + fn.args.args
+            } | {a.arg for a in fn.args.kwonlyargs}
+            traced = params - static - {"self", "cls"}
+            out.extend(self._check_defaults(ctx, fn, static))
+            out.extend(self._check_branches(ctx, fn, traced))
+        return out
+
+    def _check_defaults(self, ctx, fn: ast.FunctionDef, static: set[str]):
+        args = fn.args.posonlyargs + fn.args.args
+        defaults = fn.args.defaults
+        for arg, default in zip(args[len(args) - len(defaults):], defaults):
+            if arg.arg in static and isinstance(
+                default, (ast.List, ast.Dict, ast.Set)
+            ):
+                yield ctx.finding(
+                    self.rule_id,
+                    default,
+                    f"static arg {arg.arg!r} of jitted {fn.name}() has a "
+                    f"non-hashable default — jit hashes static args for "
+                    f"the cache key, so this raises at call time (and a "
+                    f"per-call fresh container retraces every call); "
+                    f"use a tuple/frozenset",
+                )
+
+    def _check_branches(self, ctx, fn: ast.FunctionDef, traced: set[str]):
+        # nested function defs introduce new scopes; keep it simple and
+        # only scan statements belonging to fn itself
+        for node in ast.walk(fn):
+            inner = ctx.enclosing_function(node)
+            if inner is not fn:
+                continue
+            tests: list[ast.expr] = []
+            if isinstance(node, (ast.If, ast.While)):
+                tests.append(node.test)
+            elif isinstance(node, ast.IfExp):
+                tests.append(node.test)
+            elif isinstance(node, ast.Assert):
+                tests.append(node.test)
+            for test in tests:
+                for hit in _traced_names_in_test(test, traced):
+                    yield ctx.finding(
+                        self.rule_id,
+                        node,
+                        f"jitted {fn.name}() branches in Python on "
+                        f"traced argument "
+                        f"{getattr(hit, 'id', ast.dump(hit))!r} — "
+                        f"ConcretizationTypeError at best, a retrace "
+                        f"per value at worst; use lax.cond/jnp.where "
+                        f"(mark genuinely static args static_argnames)",
+                    )

@@ -196,7 +196,7 @@ def _save_summary_stats(path, summaries, index_maps) -> None:
     for shard, s in summaries.items():
         imap = index_maps[shard]
 
-        def records():
+        def records(imap=imap, s=s):  # bind: consumed inside this iteration
             for j in range(len(imap)):
                 key = imap.get_feature_name(j)
                 name, _, term = key.partition(INTERSECT)

@@ -168,7 +168,7 @@ def parse_coordinate_config(
                 "bf16.features applies to dense feature blocks only "
                 "(sparse-ELL values stay f32)"
             )
-        if any(k.startswith("active.data") or k.startswith("passive") for k in kv):
+        if any(k.startswith(("active.data", "passive")) for k in kv):
             raise ValueError(
                 "active/passive data bounds only apply to random effects"
             )

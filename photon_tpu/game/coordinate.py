@@ -1010,7 +1010,11 @@ class RandomEffectCoordinate(Coordinate):
                 BucketCoefficients(
                     entity_ids=host_bucket.entity_ids,
                     col_index=host_bucket.col_index,
-                    coefficients=np.asarray(coefs)[:e_real],
+                    # snapshot, not view: np.asarray of the solve output
+                    # on XLA:CPU aliases the device buffer, and the state
+                    # is donated to the next fused sweep — an exported
+                    # model would silently track the live buffers
+                    coefficients=np.asarray(coefs)[:e_real].copy(),
                     variances=None if variances is None else variances[:e_real],
                 )
             )
@@ -1068,13 +1072,13 @@ class MatrixFactorizationCoordinate(Coordinate):
         # padding rows point at factor row 0 but carry weight 0
         row_idx = entity_row_indices(r_index, r_keys, 0).astype(np.int32)
         col_idx = entity_row_indices(c_index, c_keys, 0).astype(np.int32)
-        arrays = dict(
-            row_idx=row_idx,
-            col_idx=col_idx,
-            labels=np.asarray(data.labels, dtype=dtype),
-            offsets=np.asarray(data.offsets, dtype=dtype),
-            weights=np.asarray(data.weights, dtype=dtype),
-        )
+        arrays = {
+            "row_idx": row_idx,
+            "col_idx": col_idx,
+            "labels": np.asarray(data.labels, dtype=dtype),
+            "offsets": np.asarray(data.offsets, dtype=dtype),
+            "weights": np.asarray(data.weights, dtype=dtype),
+        }
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1269,8 +1273,12 @@ class MatrixFactorizationCoordinate(Coordinate):
             col_entity_type=self.config.col_entity_type,
             row_vocab=self.row_vocab,
             col_vocab=self.col_vocab,
-            row_factors=np.asarray(state[0], dtype=np.float64),
-            col_factors=np.asarray(state[1], dtype=np.float64),
+            # np.array, not np.asarray: under a float64 fit the dtype
+            # conversion is a no-op and asarray would alias the live
+            # factor buffers, which the MF sweep program DONATES — the
+            # exported model must be a snapshot
+            row_factors=np.array(state[0], dtype=np.float64),
+            col_factors=np.array(state[1], dtype=np.float64),
         )
 
 

@@ -152,7 +152,7 @@ def compile_sec_per_program() -> float:
     every consumer records it alongside the projection."""
     env = os.environ.get("PHOTON_COMPILE_SEC_PER_PROGRAM", "").strip()
     if env:
-        return float(env)
+        return float(env)  # phl-ok: PHL002 parses an env-var string, not device data
     return 60.0 if jax.default_backend() == "tpu" else 2.0
 
 
@@ -445,6 +445,7 @@ def run_coordinate_descent(
             sweep_hook(it, sweep_row)
         if validation_fn is not None:
             with obs.span("descent.validation", iteration=it):
+                # phl-ok: PHL002 validation barrier — the one sanctioned per-iteration read-back
                 metric = float(validation_fn(states))
             tracker.append({"iteration": it, "validation": metric})
             logger.info("CD iter %d validation metric %.6f", it, metric)

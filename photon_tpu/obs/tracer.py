@@ -152,6 +152,7 @@ class Tracer:
         self._ids = itertools.count(1)
         self._tls = threading.local()
         # wall-clock ↔ monotonic anchor for exporters
+        # phl-ok: PHL006 epoch anchor — the ONE wall-clock capture; all spans step from the monotonic base
         self.epoch_wall_s = time.time()
         self.epoch_ns = time.perf_counter_ns()
         self.pid = os.getpid()

@@ -394,7 +394,7 @@ def _pallas_kernel_factory(length: int, w: int, chunk: int):
     def kernel(contrib_ref, lcols_ref, out_ref):
         for i in range(_PALLAS_BLK):
 
-            def body(j, acc):
+            def body(j, acc, i=i):  # bind: fori_loop runs within this i
                 cb = contrib_ref[i, pl.ds(j * chunk, chunk)].astype(
                     jnp.float32
                 )

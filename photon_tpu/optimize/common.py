@@ -131,11 +131,12 @@ class OptimizeResult(NamedTuple):
         reason = ConvergenceReason(int(self.reason)).name
         lines = [
             f"Optimization finished: iterations={it} reason={reason} "
+            # phl-ok: PHL002 post-solve convergence report, once per solve behind its barrier
             f"loss={float(self.value):.8g} |grad|={float(jnp.linalg.norm(self.gradient)):.4g}",
             f"{'iter':>5} {'loss':>16} {'|grad|':>12}",
         ]
-        lh = np.asarray(self.loss_history)
-        gh = np.asarray(self.grad_norm_history)
+        lh = np.asarray(self.loss_history)  # phl-ok: PHL002 post-solve report read-back
+        gh = np.asarray(self.grad_norm_history)  # phl-ok: PHL002 post-solve report read-back
         for i in range(min(it + 1, lh.shape[0])):
             lines.append(f"{i:>5} {lh[i]:>16.8g} {gh[i]:>12.4g}")
         return "\n".join(lines)

@@ -83,8 +83,9 @@ def parse_constraint_string(
         lo_raw = entry.get("lowerBound")
         hi_raw = entry.get("upperBound")
         try:
+            # phl-ok: PHL002 parses JSON config bounds, not device data
             lo = -math.inf if lo_raw is None else float(lo_raw)
-            hi = math.inf if hi_raw is None else float(hi_raw)
+            hi = math.inf if hi_raw is None else float(hi_raw)  # phl-ok: PHL002 parses JSON config bounds, not device data
         except (TypeError, ValueError) as e:
             raise ValueError(
                 f"feature name [{name}] term [{term}]: bounds must be "

@@ -61,7 +61,7 @@ def main() -> int:
     # bench run starts — the one-client rule must hold against the
     # official artifact run above all.
     wait_budget_s = float(os.environ.get("RERUN_WAIT_BUDGET_S", 5400))
-    global_deadline = time.time() + wait_budget_s
+    global_deadline = time.monotonic() + wait_budget_s
     results = json.load(open(_PARTIAL))
     replaced = 0
     for name in names:
@@ -70,17 +70,17 @@ def main() -> int:
         # worst-case worker run cannot finish by the deadline (+10 min
         # grace) must not start — a late-started full-scale worker is
         # itself the second-client overlap this deadline exists to avoid
-        if time.time() + 180 + timeout_s > global_deadline + 600:
+        if time.monotonic() + 180 + timeout_s > global_deadline + 600:
             print(f"[rerun] deadline too close for {name} "
                   f"(needs {timeout_s}s); keeping stale", flush=True)
             continue
         up = probe()
-        while not up and time.time() < global_deadline:
+        while not up and time.monotonic() < global_deadline:
             print(f"[rerun] chip unreachable; retrying probe in 240s "
-                  f"({(global_deadline - time.time()) / 60:.0f} min left)",
+                  f"({(global_deadline - time.monotonic()) / 60:.0f} min left)",
                   flush=True)
             time.sleep(240)
-            if time.time() + 180 + timeout_s > global_deadline + 600:
+            if time.monotonic() + 180 + timeout_s > global_deadline + 600:
                 break
             up = probe()
         if not up:

@@ -1,0 +1,28 @@
+"""PHL001 positive: the PR 2 checkpoint corruption, minimized.
+
+The sweep loop hands its callback ``np.asarray`` views of state buffers
+that the NEXT fused sweep program receives donated — the "snapshot" the
+callback wrote to the checkpoint silently tracked the live buffers.
+"""
+import numpy as np
+
+
+def run_sweeps(states, sweep_callback, sweep_step):
+    for it in range(3):
+        states = sweep_step(states)
+        # BUG: zero-copy views of donated device buffers escape
+        sweep_callback(it, [np.asarray(s) for s in states])
+    return states
+
+
+def export_state(state):
+    return np.asarray(state)  # BUG: returned view aliases the buffer
+
+
+class Holder:
+    def capture(self, state):
+        self.snapshot = np.asarray(state)  # BUG: stored view
+
+
+def export_dict(states, sink):
+    sink({"coefs": np.asarray(states[0])})  # BUG: dict of views escapes

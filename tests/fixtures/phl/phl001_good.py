@@ -1,0 +1,22 @@
+"""PHL001 negative: donation-decoupled snapshots (the PR 2 fix)."""
+import numpy as np
+
+
+def run_sweeps(states, sweep_callback, sweep_step):
+    for it in range(3):
+        states = sweep_step(states)
+        sweep_callback(it, [np.asarray(s).copy() for s in states])
+    return states
+
+
+def export_state(state):
+    return np.array(state)  # np.array copies by default
+
+
+def export_cast(state):
+    return np.asarray(state).astype(np.float64)  # astype copies
+
+
+def local_only(state):
+    view = np.asarray(state)  # stays local: no escape, no finding
+    return float(view.sum())

@@ -1,0 +1,13 @@
+"""PHL002 positive: un-annotated host syncs in a hot-path module."""
+import numpy as np
+
+
+def sweep_loop(step, states, metric_dev):
+    for _ in range(10):
+        states = step(states)
+        states[0].block_until_ready()  # BUG: per-iteration barrier
+        loss = float(metric_dev(states))  # BUG: per-iteration sync
+        _ = metric_dev(states).item()  # BUG: scalar read-back
+        host = np.asarray(states[0])  # BUG: un-annotated materialization
+        del loss, host
+    return states

@@ -1,0 +1,18 @@
+"""PHL002 negative: the sanctioned shapes — one annotated barrier per
+sweep, declared snapshots, literal conversions."""
+import numpy as np
+
+
+def sweep_loop(step, states, read_back):
+    for _ in range(10):
+        states = step(states)
+    # phl-ok: PHL002 the one read-back barrier per sweep
+    return float(read_back(states))
+
+
+def snapshot(state):
+    return np.asarray(state).copy()  # declared snapshot — PHL001 territory
+
+
+def parse_knob(raw):
+    return float("0.5") if raw is None else int(1)  # literals are fine

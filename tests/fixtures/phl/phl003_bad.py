@@ -1,0 +1,25 @@
+"""PHL003 positive: the PR 5 leaked producer, minimized.
+
+The producer blocks on an un-interruptible ``q.put`` inside its loop,
+the hand-off queue is unbounded, and the consumer never reaps the
+thread in a ``finally`` — a consumer-side exception leaves the thread
+alive forever, holding decoded chunks.
+"""
+import queue
+import threading
+
+
+def produce(chunks, q):
+    for chunk in chunks:
+        q.put(chunk)  # BUG: blocking put in a loop, no timeout
+
+
+def stream(chunks, consume):
+    q = queue.Queue()  # BUG: unbounded staging
+    producer = threading.Thread(target=produce, args=(chunks, q))  # BUG:
+    producer.start()  # ...started but never finally-joined
+    while True:
+        item = q.get()
+        if item is None:
+            break
+        consume(item)

@@ -1,0 +1,35 @@
+"""PHL003 negative: bounded staging, stop-event puts, finally reap —
+the PR 5 fix shape."""
+import queue
+import threading
+
+
+def produce(chunks, q, stop):
+    for chunk in chunks:
+        while not stop.is_set():
+            try:
+                q.put(chunk, timeout=0.05)
+                break
+            except queue.Full:
+                continue
+
+
+def stream(chunks, consume):
+    q = queue.Queue(maxsize=2)
+    stop = threading.Event()
+    producer = threading.Thread(target=produce, args=(chunks, q, stop))
+    producer.start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            consume(item)
+    finally:
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        producer.join(timeout=5.0)

@@ -1,0 +1,22 @@
+"""PHL004 positive: the PR 3 use-after-free, minimized.
+
+``bag_key_pool`` bound as POINTER(c_char_p): indexing materializes a
+temporary Python bytes copy; a pointer taken into it dangles once the
+temporary is collected, and under allocation churn the keys decode as
+heap garbage.
+"""
+import ctypes
+
+
+class _CDecoded(ctypes.Structure):
+    _fields_ = [
+        ("n", ctypes.c_int64),
+        # BUG: char** bound as POINTER(c_char_p)
+        ("bag_key_pool", ctypes.POINTER(ctypes.c_char_p)),
+    ]
+
+
+def read_keys(lib, handle):
+    lib.decode.restype = ctypes.POINTER(ctypes.c_char_p)  # BUG: same class
+    pool = ctypes.cast(handle, ctypes.POINTER(ctypes.c_char_p))  # BUG
+    return pool[0]

@@ -1,0 +1,18 @@
+"""PHL004 negative: the PR 3 fix — raw addresses into C-owned memory,
+sliced with string_at (valid until the C free)."""
+import ctypes
+
+
+class _CDecoded(ctypes.Structure):
+    _fields_ = [
+        ("n", ctypes.c_int64),
+        # char** bound as void* addresses ON PURPOSE
+        ("bag_key_pool", ctypes.POINTER(ctypes.c_void_p)),
+        ("uid_pool", ctypes.POINTER(ctypes.c_char)),
+    ]
+
+
+def read_keys(d, offs):
+    total = int(offs[-1]) if len(offs) else 0
+    raw = ctypes.string_at(d.bag_key_pool[0] or 0, total) if total else b""
+    return [raw[offs[i]: offs[i + 1]] for i in range(len(offs) - 1)]

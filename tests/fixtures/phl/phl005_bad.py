@@ -1,0 +1,25 @@
+"""PHL005 positive: retrace hazards inside jit-decorated functions."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_tracer(x, threshold):
+    if threshold > 0:  # BUG: Python branch on a traced argument
+        return x * 2
+    return x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def loop_on_tracer(x, n, mask):
+    while mask.any():  # BUG: mask is traced (n is static and exempt)
+        x = x - 1
+        mask = x > 0
+    return x
+
+
+@partial(jax.jit, static_argnames=("shapes",))
+def bad_static_default(x, shapes=[8, 16]):  # BUG: unhashable static default
+    return jnp.reshape(x, shapes[0])

@@ -1,0 +1,36 @@
+"""PHL005 negative: static branching, structure checks, lax control flow."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_branch(x, mode):
+    if mode == "double":  # mode is static — branch is trace-time only
+        return x * 2
+    return x
+
+
+@jax.jit
+def structure_check(x, offsets=None):
+    if offsets is None:  # pytree STRUCTURE is static under jit
+        return x
+    return x + offsets
+
+
+@jax.jit
+def shape_branch(x):
+    if x.shape[0] > 8:  # shapes are static metadata
+        return x[:8]
+    return x
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def hashable_static_default(x, levels=(8, 16)):
+    return jnp.reshape(x, levels[0])
+
+
+@jax.jit
+def device_branch(x, threshold):
+    return jnp.where(threshold > 0, x * 2, x)  # branch stays on device

@@ -1,0 +1,17 @@
+"""PHL006 positive: wall-clock durations and deadlines."""
+import time
+
+
+def timed(fn):
+    t0 = time.time()  # BUG: duration from the wall clock
+    fn()
+    return time.time() - t0  # BUG
+
+
+def wait_until(probe, budget_s):
+    deadline = time.time() + budget_s  # BUG: NTP steps move the deadline
+    while not probe():
+        if time.time() > deadline:  # BUG
+            return False
+        time.sleep(1)
+    return True

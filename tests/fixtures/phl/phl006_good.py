@@ -1,0 +1,24 @@
+"""PHL006 negative: monotonic durations; one annotated epoch anchor."""
+import time
+
+
+def timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def wait_until(probe, budget_s):
+    deadline = time.monotonic() + budget_s
+    while not probe():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(1)
+    return True
+
+
+class Anchor:
+    def __init__(self):
+        # phl-ok: PHL006 epoch anchor: one wall capture aligned to the monotonic base
+        self.epoch_wall_s = time.time()
+        self.epoch_ns = time.perf_counter_ns()

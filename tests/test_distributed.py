@@ -213,9 +213,10 @@ def test_re_train_program_has_no_collectives():
     is pure overhead on real ICI and fatal straggle on the single-core
     virtual mesh (XLA:CPU in-process rendezvous aborts at 40 s). The
     shard_map per-shard-independent lowering guarantees it; this pins
-    the guarantee against refactors."""
-    import re as _re
-
+    the guarantee against refactors. The collective-matching pass lives
+    in photon_tpu.analysis.hlo, shared with the whole-fit audit over
+    every AOT-precompiled executable."""
+    from photon_tpu.analysis.hlo import check_no_collectives
     from photon_tpu.game.config import RandomEffectCoordinateConfig
     from photon_tpu.game.coordinate import RandomEffectCoordinate
     from photon_tpu.game.data import (
@@ -257,14 +258,8 @@ def test_re_train_program_has_no_collectives():
         )
         .compile()
     )
-    hlo = compiled.as_text()
-    collectives = sorted(
-        set(_re.findall(r"all-\w+|collective-\w+|reduce-scatter", hlo))
-    )
-    assert collectives == [], (
-        f"RE train program lowered cross-device collectives {collectives} — "
-        "the shard_map per-shard-independent solve contract is broken"
-    )
+    findings = check_no_collectives(compiled, "RE._train_bucket")
+    assert not findings, "\n".join(f.render() for f in findings)
 
     # the fused MULTI-BUCKET train program (the descent hot path) must
     # hold the same contract: it composes the same per-shard-independent
@@ -279,14 +274,7 @@ def test_re_train_program_has_no_collectives():
         )
         .compile()
     )
-    collectives_all = sorted(
-        set(
-            _re.findall(
-                r"all-\w+|collective-\w+|reduce-scatter",
-                compiled_all.as_text(),
-            )
-        )
+    findings_all = check_no_collectives(
+        compiled_all, "RE._train_all_jit (fused multi-bucket)"
     )
-    assert collectives_all == [], (
-        f"fused multi-bucket RE train lowered collectives {collectives_all}"
-    )
+    assert not findings_all, "\n".join(f.render() for f in findings_all)

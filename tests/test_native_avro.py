@@ -290,11 +290,11 @@ def test_native_scoring_writer_parity(tmp_path, with_optional):
     if with_optional:
         uids = [f"id{i}" if i % 5 else None for i in range(n)]
         uids[1] = ""  # empty string must survive as "", not null
-        kw = dict(
-            labels=(rng.uniform(size=n) > 0.5).astype(float),
-            weights=rng.uniform(0.5, 2.0, size=n),
-            uids=uids,
-        )
+        kw = {
+            "labels": (rng.uniform(size=n) > 0.5).astype(float),
+            "weights": rng.uniform(0.5, 2.0, size=n),
+            "uids": uids,
+        }
     p_native = tmp_path / "native.avro"
     p_python = tmp_path / "python.avro"
     assert save_scoring_results(p_native, scores, model_id="m", **kw) == n

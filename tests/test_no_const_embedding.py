@@ -7,6 +7,11 @@ HTTP 413 rejections at ~256 MB and a >19-minute compile hang at 814 MB
 (PERF.md). The contract is that batches/buckets/index streams ride as
 jit ARGUMENTS; this test traces each hot entry point and fails if any
 jaxpr constant is larger than a scalar-ish epsilon, naming the offender.
+
+The pass itself (the recursive const walker and the size check) lives in
+photon_tpu.analysis.hlo — shared with the audit that runs over every
+AOT-precompiled executable (`python -m photon_tpu.analysis --programs`);
+this file keeps the hand-picked high-value traces as named regressions.
 """
 from __future__ import annotations
 
@@ -14,6 +19,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_tpu.analysis.hlo import (
+    DEFAULT_CONST_BYTES_LIMIT as _CONST_BYTES_LIMIT,
+    check_jaxpr_const_embedding,
+    collect_jaxpr_consts,
+)
 from photon_tpu.game.config import (
     FixedEffectCoordinateConfig,
     RandomEffectCoordinateConfig,
@@ -28,40 +38,10 @@ from photon_tpu.optimize.problem import (
 )
 from photon_tpu.types import TaskType
 
-#: anything bigger than this many bytes in a traced program's consts is a
-#: data array smuggled through a closure, not a tolerable scalar table
-_CONST_BYTES_LIMIT = 16 * 1024
-
-
-def _collect_consts(closed_jaxpr, out):
-    """Consts of this jaxpr AND of every nested ClosedJaxpr: a jitted
-    callee's closure constants live on the inner pjit equation's jaxpr —
-    the outer ``make_jaxpr`` consts list stays empty, so a non-recursive
-    check is vacuous for exactly the functions this guard protects."""
-    out.extend(closed_jaxpr.consts)
-    for eqn in closed_jaxpr.jaxpr.eqns:
-        for v in eqn.params.values():
-            if hasattr(v, "jaxpr") and hasattr(v, "consts"):  # ClosedJaxpr
-                _collect_consts(v, out)
-            elif isinstance(v, (list, tuple)):
-                for item in v:
-                    if hasattr(item, "jaxpr") and hasattr(item, "consts"):
-                        _collect_consts(item, out)
-
 
 def _assert_no_large_consts(jaxpr, label):
-    consts: list = []
-    _collect_consts(jaxpr, consts)
-    offenders = [
-        (np.asarray(c).nbytes, getattr(c, "shape", None))
-        for c in consts
-        if hasattr(c, "nbytes") and np.asarray(c).nbytes > _CONST_BYTES_LIMIT
-    ]
-    assert not offenders, (
-        f"{label}: traced program embeds {offenders} as constants — pass "
-        "the data as jit arguments (HTTP 413 / remote-compile hang class, "
-        "PERF.md r4)"
-    )
+    findings = check_jaxpr_const_embedding(jaxpr, label, _CONST_BYTES_LIMIT)
+    assert not findings, "\n".join(f.render() for f in findings)
 
 
 def test_guard_detects_planted_closure_constant():
@@ -76,7 +56,7 @@ def test_guard_detects_planted_closure_constant():
 
     jaxpr = jax.make_jaxpr(lambda v: leaky(v))(jnp.float32(2.0))
     consts: list = []
-    _collect_consts(jaxpr, consts)
+    collect_jaxpr_consts(jaxpr, consts)
     sizes = [np.asarray(c).nbytes for c in consts if hasattr(c, "nbytes")]
     assert any(s > _CONST_BYTES_LIMIT for s in sizes), (
         "guard walker failed to find the planted 256 KB closure constant — "
