@@ -167,7 +167,12 @@ SMOKE = os.environ.get("BENCH_SMOKE", "") == "1"
 #:   3 (r6+) — still active-based, but rows now ALSO carry the touched
 #:             count (``examples_per_sec_touched``, the v1-comparable
 #:             series) plus the compile-bill split.
-METRIC_VERSION = 3
+#:   4 (r9+) — throughput unchanged from v3; GAME rows additionally
+#:             carry the device-memory ledger columns (``mem.peak_bytes``
+#:             live high-watermark, ``mem.exec_temp_bytes`` XLA scratch
+#:             across the AOT executables, H2D/D2H bytes) — capacity
+#:             claims become measured columns, gated by QUALITY_BANDS.
+METRIC_VERSION = 4
 
 #: Per-config quality bands (VERDICT r5 next #6): a config that produces
 #: a throughput number while its MODEL is garbage must FAIL, not publish.
@@ -181,11 +186,17 @@ QUALITY_BANDS = {
     "a1a_logistic_lbfgs": {"gnorm_max": 1.0},
     "linear_tron": {"gnorm_max": 100.0},
     "sparse_poisson_owlqn": {"gnorm_max": 5000.0},
+    # require_memory: a GAME row without its device-memory ledger
+    # columns (mem.peak_bytes high-watermark > 0, mem.exec_temp_bytes
+    # present) is a capacity claim with no accounting behind it — the
+    # ledger broke or was disabled, and the row must fail, not publish
     "glmix_game_estimator": {
-        "grouped_auc_min": {"smoke": 0.55, "cpu": 0.8, "tpu": 0.8}
+        "grouped_auc_min": {"smoke": 0.55, "cpu": 0.8, "tpu": 0.8},
+        "require_memory": True,
     },
     "game_ctr_scale": {
-        "grouped_auc_min": {"smoke": 0.55, "cpu": 0.8, "tpu": 0.8}
+        "grouped_auc_min": {"smoke": 0.55, "cpu": 0.8, "tpu": 0.8},
+        "require_memory": True,
     },
     # the streaming scorer must be BIT-PARITY (f32 accumulation tolerance)
     # with the monolithic host path, and its steady state must dispatch
@@ -236,6 +247,20 @@ def check_quality_bands(name: str, detail: dict) -> list[str]:
             out.append(
                 f"steady-state scoring compiled {sc} programs "
                 f"(> {steady_max}; retrace leaked into the hot loop)"
+            )
+    if band.get("require_memory"):
+        mem = detail.get("mem") or {}
+        peak = mem.get("peak_bytes")
+        if peak is None or not math.isfinite(peak) or peak <= 0:
+            out.append(
+                f"mem.peak_bytes {peak!r} absent or non-positive — the "
+                "device-memory ledger produced no live-census data for a "
+                "GAME config"
+            )
+        if mem.get("exec_temp_bytes") is None:
+            out.append(
+                "mem.exec_temp_bytes absent — no AOT executable reported "
+                "a static footprint"
             )
     auc_min = band.get("grouped_auc_min")
     if auc_min is not None:
@@ -1327,11 +1352,29 @@ def _run_game_config(
         "trace_path": paths["trace"],
         "metrics_path": paths["metrics"],
         "manifest_path": paths["manifest"],
+        "memory_path": paths["memory"],
         "phase_wall_s": {
             name: agg["total_s"] for name, agg in phase_summary().items()
         },
     }
+    # device-memory ledger columns (metric_version 4): the live-census
+    # high-watermark, XLA's per-executable scratch total, and the
+    # transfer bill — read BEFORE obs.reset() drops the run state
+    mem_report = obs.memory.get_ledger().report()
+    mem_detail = {
+        "peak_bytes": mem_report["peak_live_bytes"],
+        "exec_temp_bytes": mem_report["executables_total"]["temp_bytes"],
+        "exec_argument_bytes": mem_report["executables_total"][
+            "argument_bytes"
+        ],
+        "n_executables_analyzed": mem_report["executables_total"][
+            "n_analyzed"
+        ],
+        "h2d_bytes": mem_report["h2d_bytes"],
+        "d2h_bytes": mem_report["d2h_bytes"],
+    }
     _log("[bench] run profile:\n" + summary_table())
+    _log(f"[bench] memory ledger: {mem_detail}")
     # artifact written — telemetry back off so non-GAME configs run (and
     # are timed) unprofiled, and spans don't accumulate across configs
     obs.disable()
@@ -1343,6 +1386,7 @@ def _run_game_config(
         "fe_nnz": fe_nnz,
         "value_entropy": value_entropy,
         "obs": obs_detail,
+        "mem": mem_detail,
         "fe_layout": "sparse_ell" if fe_nnz < fe_dim else "dense",
         "coordinates": {
             name: {"num_entities": ne, "d_re": dr, "active_upper_bound": ub}
@@ -1683,12 +1727,29 @@ def config_scoring_stream(peak_flops, scale):
             "trace_path": paths["trace"],
             "metrics_path": paths["metrics"],
             "manifest_path": paths["manifest"],
+            "memory_path": paths["memory"],
             "phase_wall_s": {
                 name: agg["total_s"]
                 for name, agg in phase_summary().items()
             },
         }
+        # memory ledger columns for the measured warm stream (the AOT
+        # score executable's static footprint rides along from the
+        # precompile above — it survives obs.reset by design)
+        mem_report = obs.memory.get_ledger().report()
+        mem_detail = {
+            "peak_bytes": mem_report["peak_live_bytes"],
+            "exec_temp_bytes": mem_report["executables_total"][
+                "temp_bytes"
+            ],
+            "n_executables_analyzed": mem_report["executables_total"][
+                "n_analyzed"
+            ],
+            "h2d_bytes": mem_report["h2d_bytes"],
+            "d2h_bytes": mem_report["d2h_bytes"],
+        }
         _log("[bench] scoring run profile:\n" + summary_table())
+        _log(f"[bench] memory ledger: {mem_detail}")
         obs.disable()
         obs.reset()
         m2_scores, m2_wall = run_mono()
@@ -1742,6 +1803,7 @@ def config_scoring_stream(peak_flops, scale):
             "speedup_vs_monolithic": round(stream_sps / mono_sps, 3),
             "examples_per_sec": round(stream_sps, 1),
             "obs": obs_detail,
+            "mem": mem_detail,
         }
     finally:
         shutil.rmtree(in_dir, ignore_errors=True)

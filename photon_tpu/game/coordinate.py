@@ -42,6 +42,8 @@ from photon_tpu.game.model import (
 )
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import model_for_task
+from photon_tpu.obs import memory as obs_memory
+from photon_tpu.obs.health import sweep_health
 from photon_tpu.ops.losses import POSITIVE_RESPONSE_THRESHOLD
 from photon_tpu.ops.normalization import NormalizationContext
 from photon_tpu.data.dataset import choose_sparse
@@ -134,7 +136,13 @@ class Coordinate:
         discipline and the actual donation can never diverge mid-run);
         ``None`` falls back to ``sweep_donation_enabled()``.
 
-        → ``(new_state, new_score, new_total, info)``.
+        → ``(new_state, new_score, new_total, info, health)``, where
+        ``health`` is the per-coordinate loss/gnorm/isfinite triple of
+        0-d device scalars (photon_tpu/obs/health.py) computed from the
+        step's own outputs — inside the fused program on the subclass
+        paths (zero extra dispatches; descent reads it back AS the sweep
+        barrier), eagerly here. ``None`` where the fold would add
+        collectives (entity-sharded RE states under a mesh).
 
         This base implementation is the UNFUSED reference sequence — the
         same dispatches the descent loop used to issue one by one (kept as
@@ -149,7 +157,10 @@ class Coordinate:
         new_score = self.score(new_state)
         new_total = residual + new_score
         dispatch_count.record(2)  # the two eager elementwise [N] updates
-        return new_state, new_score, new_total, info
+        health = (
+            sweep_health(new_state, info) if self.mesh is None else None
+        )
+        return new_state, new_score, new_total, info, health
 
     #: (donating, non-donating) fused-step pair, set per subclass via
     #: ``_make_sweep_jits``
@@ -342,6 +353,9 @@ class FixedEffectCoordinate(Coordinate):
                 return jnp.asarray(a, dtype=dtype)
 
             batch = jax.tree_util.tree_map(_to_device, batch)
+        # placement choke point: the batch block is the coordinate's H2D
+        # bill (ledger no-op unless obs + PHOTON_OBS_MEM are live)
+        obs_memory.count_h2d(obs_memory.tree_device_bytes(batch))
         problem = GLMProblem.build(
             config.optimization.with_regularization_weight(
                 config.regularization_weights[0]
@@ -474,7 +488,10 @@ class FixedEffectCoordinate(Coordinate):
         )
         new_score = self._score_body(batch, norm_args, res.x)
         new_total = constrain_rows(residual + new_score, self.mesh)
-        return res.x, new_score, new_total, res
+        # health scalars fold into THIS program (coefficients and the
+        # solve outputs are replicated under a mesh, so the reductions
+        # stay collective-free); descent reads them back as the barrier
+        return res.x, new_score, new_total, res, sweep_health(res.x, res)
 
     _sweep_jit, _sweep_jit_nodonate = _make_sweep_jits(
         _sweep_body, static_argnums=0, donate_argnums=(3, 4, 5)
@@ -679,6 +696,19 @@ class RandomEffectCoordinate(Coordinate):
                     )
                 )
             )
+        # placement choke point: every bucket's device-resident blocks
+        obs_memory.count_h2d(
+            sum(
+                obs_memory.tree_device_bytes(
+                    (
+                        db.features, db.labels, db.offsets,
+                        db.train_weights, db.sample_pos, db.score_feats,
+                        db.score_slot, db.score_pos,
+                    )
+                )
+                for db in device_buckets
+            )
+        )
         return RandomEffectCoordinate(
             config=config,
             dataset=dataset,
@@ -912,7 +942,14 @@ class RandomEffectCoordinate(Coordinate):
                 sf, ss, sp, coefs, pad
             )
         new_total = residual + new_score
-        return new_state, new_score, new_total, infos
+        # health fold only off-mesh: reducing entity-SHARDED per-bucket
+        # values/gradients to replicated scalars would put an all-reduce
+        # into the RE sweep program, breaking the no-collectives contract
+        # (analysis/hlo.audit_coordinates scopes it to RE programs)
+        health = (
+            sweep_health(new_state, infos) if self.mesh is None else None
+        )
+        return new_state, new_score, new_total, infos, health
 
     _sweep_jit, _sweep_jit_nodonate = _make_sweep_jits(
         _sweep_body, static_argnums=(0, 6), donate_argnums=(3, 4, 5)
@@ -1088,6 +1125,8 @@ class MatrixFactorizationCoordinate(Coordinate):
             }
         else:
             arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
+        # placement choke point: the per-sample index/label/weight columns
+        obs_memory.count_h2d(obs_memory.tree_device_bytes(arrays))
         return MatrixFactorizationCoordinate(
             config=config,
             row_vocab=row_vocab,
@@ -1226,7 +1265,9 @@ class MatrixFactorizationCoordinate(Coordinate):
         )
         new_score = self._score_body(row_idx, col_idx, weights, (u, v))
         new_total = residual + new_score
-        return (u, v), new_score, new_total, res
+        # factor tables and the joint solve outputs are replicated, so
+        # the health reductions are collective-free mesh or no mesh
+        return (u, v), new_score, new_total, res, sweep_health((u, v), res)
 
     _sweep_jit, _sweep_jit_nodonate = _make_sweep_jits(
         _sweep_body, static_argnums=0, donate_argnums=(2, 3, 4)

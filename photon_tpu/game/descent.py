@@ -29,8 +29,9 @@ import jax
 
 from photon_tpu import obs
 from photon_tpu.game.coordinate import Coordinate, sweep_donation_enabled
+from photon_tpu.obs.health import DivergenceError, resolve_policy
 from photon_tpu.util import compile_watch, dispatch_count
-from photon_tpu.util.force import force
+from photon_tpu.util.force import fetch_scalars, force
 
 logger = logging.getLogger(__name__)
 
@@ -110,6 +111,10 @@ def precompile_coordinates(
                 "cache_misses": 0,
             }
         coord.aot_executables()[key] = compiled
+        # static footprint into the memory ledger: XLA's own
+        # argument/output/temp/generated-code accounting per executable
+        # (recorded unconditionally — compile time, never the hot path)
+        obs.memory.record_executable(label, compiled)
         return {
             "program": label,
             "wall_s": round(wall, 4),
@@ -230,6 +235,43 @@ def _copy_device_leaves(tree):
     return _copy_tree_jit(tree)
 
 
+def _read_health(
+    health_dev: Mapping[str, dict | None], barrier
+) -> dict[str, dict]:
+    """Host health rows from the per-coordinate device triples, fetched
+    in ONE device→host round trip that doubles as the sweep's completion
+    barrier when ``barrier`` is given (util/force.fetch_scalars). The
+    phl annotation below marks the ONE sanctioned steady-state sync —
+    the same barrier the sweep always paid, now carrying the health
+    payload."""
+    order = [cid for cid, h in health_dev.items() if h is not None]
+    flat = []
+    for cid in order:
+        h = health_dev[cid]
+        flat.extend((h["loss"], h["gnorm"], h["finite"]))
+    # phl-ok: PHL002 THE per-sweep barrier read-back — health scalars ride the existing sync
+    vals = fetch_scalars(flat, barrier=barrier)
+    out: dict[str, dict] = {}
+    for i, cid in enumerate(order):
+        loss, gnorm, finite = vals[3 * i : 3 * i + 3].tolist()
+        out[cid] = {
+            "loss": loss,
+            "gnorm": gnorm,
+            "finite": bool(finite),
+        }
+    return out
+
+
+def _record_health_metrics(health: Mapping[str, dict]) -> None:
+    """Mirror the sweep's host health rows into ``health.*`` telemetry
+    (no-ops while obs is disabled)."""
+    obs.counter("health.checks")
+    for cid, h in health.items():
+        obs.gauge(f"health.loss.{cid}", h["loss"])
+        obs.gauge(f"health.gnorm.{cid}", h["gnorm"])
+        obs.histogram("health.gnorm", h["gnorm"])
+
+
 def run_coordinate_descent(
     coordinates: Mapping[str, Coordinate],
     update_sequence: Sequence[str],
@@ -245,6 +287,7 @@ def run_coordinate_descent(
     sweep_hook: Callable | None = None,
     tracker_granularity: str = "sweep",
     fused: bool = True,
+    on_divergence: str | None = None,
 ) -> CoordinateDescentResult:
     """Run block coordinate descent.
 
@@ -306,7 +349,22 @@ def run_coordinate_descent(
     fields as always, one clock. With telemetry disabled the spans
     reduce to bare monotonic clock reads; nothing extra is dispatched
     or read back in either mode.
+
+    Health monitoring (photon_tpu/obs/health.py): every sweep step
+    computes a per-coordinate loss / grad-norm / ``isfinite`` triple
+    INSIDE its already-dispatched program, and the scalars ride the
+    sweep's ONE read-back barrier home (``util/force.fetch_scalars`` —
+    zero extra dispatches, zero extra read-backs; the dispatch-count
+    tests pin this). ``on_divergence`` decides what a non-finite
+    coordinate does at the sweep boundary: ``"raise"`` (default; a
+    :class:`photon_tpu.obs.health.DivergenceError` instead of a silently
+    poisoned checkpoint), ``"warn"``, or ``"halt_coordinate"``
+    (re-initialize + freeze the offender, keep training the rest —
+    recovery dispatches are paid only at the divergence boundary).
+    ``None`` resolves via ``PHOTON_ON_DIVERGENCE``. Host health values
+    land in the per-sweep tracker rows as ``health``.
     """
+    on_divergence = resolve_policy(on_divergence)
     if tracker_granularity not in ("sweep", "coordinate"):
         raise ValueError(
             f"tracker_granularity must be 'sweep' or 'coordinate', got "
@@ -357,11 +415,17 @@ def run_coordinate_descent(
 
     trainable = [c for c in update_sequence if c not in locked_coordinates]
     per_coordinate = tracker_granularity == "coordinate"
+    halted: set[str] = set()
     for it in range(start_iteration, num_iterations):
         d0 = dispatch_count.snapshot()
         c0 = compile_watch.snapshot()
+        #: cid → the step's {loss, gnorm, finite} device scalars (None
+        #: where the coordinate kind can't fold them collective-free)
+        health_dev: dict[str, dict | None] = {}
         with obs.span("descent.sweep", iteration=it) as sweep_span:
             for cid in trainable:
+                if cid in halted:
+                    continue
                 coord = coordinates[cid]
                 with obs.span(
                     "descent.coordinate", iteration=it, coordinate=cid
@@ -370,17 +434,21 @@ def run_coordinate_descent(
                         # donating decided ONCE at entry and threaded
                         # through, so the copy discipline above cannot
                         # diverge from the donation the programs perform
-                        new_state, new_score, total, info = coord.sweep_step(
-                            total, scores[cid], states[cid], donate=donating
+                        new_state, new_score, total, info, hlth = (
+                            coord.sweep_step(
+                                total, scores[cid], states[cid],
+                                donate=donating,
+                            )
                         )
                     else:
-                        new_state, new_score, total, info = (
+                        new_state, new_score, total, info, hlth = (
                             Coordinate.sweep_step(
                                 coord, total, scores[cid], states[cid]
                             )
                         )
                     scores[cid] = new_score
                     states[cid] = new_state
+                    health_dev[cid] = hlth
                     if per_coordinate:
                         # a read-back is the only honest boundary for per-
                         # coordinate seconds (block_until_ready can return
@@ -409,10 +477,19 @@ def run_coordinate_descent(
             if not per_coordinate:
                 # sync-free steady state: ONE read-back closes the whole
                 # sweep (new_total depends on every coordinate's train +
-                # rescore)
+                # rescore), and the health scalars ride home IN that
+                # same fetch — still exactly one read-back per sweep
                 with obs.span("descent.barrier", iteration=it) as bar_span:
-                    force(total)
+                    health = _read_health(health_dev, barrier=total)
                 barrier_s = bar_span.duration_s
+            else:
+                # profiling mode already paid a round trip per
+                # coordinate; the health fetch is one more
+                health = _read_health(health_dev, barrier=None)
+            # phase-boundary live-buffer census (host metadata only — a
+            # gated no-op that never dispatches or reads back; see
+            # photon_tpu/obs/memory.py)
+            obs.memory.census("sweep_barrier")
             cw = compile_watch.delta(c0)
             dispatches = dispatch_count.snapshot() - d0
             # the counters ride on the sweep span so the exported trace
@@ -436,13 +513,54 @@ def run_coordinate_descent(
             "compiles": cw["backend_compiles"],
             "compile_seconds": cw["backend_compile_s"],
             "granularity": tracker_granularity,
+            "health": health,
         }
         tracker.append(sweep_row)
         obs.counter("descent.sweeps")
         obs.histogram("descent.sweep_seconds", sweep_span.duration_s)
         obs.histogram("descent.barrier_seconds", barrier_s)
+        _record_health_metrics(health)
+        diverged = [
+            cid for cid, h in health.items() if not h["finite"]
+        ]
         if sweep_hook is not None:
             sweep_hook(it, sweep_row)
+        for cid in diverged:
+            obs.counter("health.divergence")
+            obs.instant(
+                "health.divergence",
+                cat="lifecycle",
+                coordinate=cid,
+                iteration=it,
+                policy=on_divergence,
+                **health[cid],
+            )
+            if on_divergence == "raise":
+                raise DivergenceError(cid, it, health[cid])
+            if on_divergence == "halt_coordinate":
+                logger.warning(
+                    "coordinate %s diverged at sweep %d (%s); "
+                    "re-initializing and halting it for the rest of "
+                    "this descent",
+                    cid, it, health[cid],
+                )
+                halted.add(cid)
+                # recovery (divergence boundary only, never steady
+                # state): fresh state, fresh score, total rebuilt from
+                # scratch — the old total carries the NaN
+                states[cid] = coordinates[cid].initial_state()
+                scores[cid] = coordinates[cid].score(states[cid])
+                total = None
+                for s in scores.values():
+                    total = s if total is None else total + s
+                if donating and len(scores) == 1:
+                    total = _copy_device_leaves(total)
+            else:
+                logger.warning(
+                    "coordinate %s diverged at sweep %d (%s); policy "
+                    "'warn' — training continues on non-finite state",
+                    cid, it, health[cid],
+                )
         if validation_fn is not None:
             with obs.span("descent.validation", iteration=it):
                 # phl-ok: PHL002 validation barrier — the one sanctioned per-iteration read-back

@@ -127,6 +127,17 @@ class GameEstimator:
     #: the same lifecycle stream the CLI drivers always had. Excluded
     #: from the checkpoint fingerprint (listeners don't change numerics).
     events: object | None = None
+    #: what a NON-FINITE coordinate (NaN/Inf loss, gradient, or state —
+    #: photon_tpu/obs/health.py) does at the sweep boundary where the
+    #: health monitor catches it: "raise" (default — fail loudly with
+    #: DivergenceError instead of silently poisoning the checkpoint and
+    #: every later sweep), "warn" (log + event, keep going), or
+    #: "halt_coordinate" (re-initialize + freeze the offender, train the
+    #: rest). None resolves via the PHOTON_ON_DIVERGENCE env. The
+    #: monitor itself is free: health scalars are computed inside the
+    #: already-dispatched sweep programs and ride the existing per-sweep
+    #: read-back barrier.
+    on_divergence: str | None = None
 
     def __post_init__(self):
         #: per-fit telemetry deltas (wall, dispatches, compiles) for the
@@ -145,6 +156,10 @@ class GameEstimator:
                 "tracker_granularity must be 'sweep' or 'coordinate', got "
                 f"{self.tracker_granularity!r}"
             )
+        from photon_tpu.obs.health import resolve_policy
+
+        # validate (and env-resolve) at construction, not mid-fit
+        self.on_divergence = resolve_policy(self.on_divergence)
 
     # ------------------------------------------------------------------
 
@@ -411,6 +426,10 @@ class GameEstimator:
             coordinates, re_datasets = self._build_coordinates(
                 data, initial_model, shape_pool=shape_pool
             )
+        # phase-boundary memory censuses (photon_tpu/obs/memory.py):
+        # host-metadata snapshots of every live device buffer — gated
+        # no-ops that never dispatch or read back
+        obs.memory.census("data_build")
 
         from photon_tpu.util import compile_watch
 
@@ -426,6 +445,7 @@ class GameEstimator:
                     n_programs=precompile_report["n_programs"],
                     cache_hits=precompile_report["cache_hits"],
                 )
+            obs.memory.census("precompile")
 
         init_states = None
         if initial_model is not None:
@@ -433,6 +453,7 @@ class GameEstimator:
                 init_states = self._states_from_model(
                     initial_model, coordinates, re_datasets
                 )
+            obs.memory.census("warm_start")
 
         validation_fn = None
         if validation_data is not None and self.validation_evaluator is not None:
@@ -548,6 +569,7 @@ class GameEstimator:
                         sweep_seconds=row["sweep_seconds"],
                         dispatches=row["dispatches"],
                         compiles=row["compiles"],
+                        health=row.get("health"),
                     )
                 )
 
@@ -571,6 +593,7 @@ class GameEstimator:
                     sweep_callback=sweep_callback,
                     sweep_hook=sweep_hook,
                     tracker_granularity=self.tracker_granularity,
+                    on_divergence=self.on_divergence,
                 )
             final_states = (
                 cd.best_states if cd.best_states is not None else cd.states

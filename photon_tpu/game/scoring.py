@@ -539,6 +539,9 @@ class GameScorer:
             "precompile.program", cat="compile", program="score"
         ):
             self._aot[key] = self._jit.lower(self._params, sds).compile()
+        # static footprint per batch shape into the memory ledger (what
+        # each scoring shape NEEDS on device, from XLA's own accounting)
+        obs.memory.record_executable(f"score:{key}", self._aot[key])
         return {
             "program": "score",
             "key": key,
@@ -622,6 +625,7 @@ class GameScorer:
         def finish(pending) -> None:
             dev_scores, chunk, t_dispatch = pending
             with obs.span("score.readback", rows=chunk.num_samples):
+                obs.memory.count_d2h(int(dev_scores.nbytes))
                 scores = np.asarray(dev_scores)[: chunk.num_samples].astype(
                     np.float64
                 )
@@ -641,6 +645,10 @@ class GameScorer:
                     on_batch(chunk, scores)
 
         with obs.span("score.stream") as root:
+            # phase-boundary censuses: what is live on device at stream
+            # start/end (model tables should be the whole bill; batches
+            # must NOT accumulate) — host metadata only, never a sync
+            obs.memory.census("stream_start")
             producer.start()
             pending = None
             failure: BaseException | None = None
@@ -667,6 +675,11 @@ class GameScorer:
                         )
                     with obs.span("score.h2d"):
                         batch_dev = jax.device_put(host_batch)
+                        # ingest choke point: the batch's H2D bill (from
+                        # placed-handle metadata — free, gated no-op)
+                        obs.memory.count_h2d(
+                            obs.memory.tree_device_bytes(batch_dev)
+                        )
                     t_dispatch = time.perf_counter()
                     dev_scores = self._dispatch(batch_dev, key)
                     # double buffer: batch i's read-back happens only
@@ -704,6 +717,7 @@ class GameScorer:
             stats.compiles = compile_watch.delta(cw_start)
             stats.wall_s = time.perf_counter() - t_start
             root.set(batches=stats.batches, samples=stats.samples)
+            obs.memory.census("stream_end")
         return StreamResult(
             scores=(
                 np.concatenate(collected)

@@ -38,12 +38,15 @@ from __future__ import annotations
 
 import os
 
+from photon_tpu.obs import health, memory
 from photon_tpu.obs.export import (
     chrome_trace,
     export_artifacts,
+    histogram_summary,
     phase_summary,
     summary_table,
     write_chrome_trace,
+    write_memory_report,
     write_metrics,
     write_run_manifest,
 )
@@ -63,13 +66,17 @@ __all__ = [
     "gauge",
     "get_registry",
     "get_tracer",
+    "health",
     "histogram",
+    "histogram_summary",
     "instant",
+    "memory",
     "phase_summary",
     "reset",
     "span",
     "summary_table",
     "write_chrome_trace",
+    "write_memory_report",
     "write_metrics",
     "write_run_manifest",
 ]
@@ -102,10 +109,14 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Drop every recorded span and zero the registry (artifact boundary:
-    bench calls this per config so each artifact holds one run)."""
+    """Drop every recorded span, zero the registry, and clear the memory
+    ledger's per-run state (artifact boundary: bench calls this per
+    config so each artifact holds one run). Static executable footprints
+    survive — they describe process-lifetime compiled programs (see
+    photon_tpu/obs/memory.py)."""
     _tracer.clear()
     _registry.clear()
+    memory.get_ledger().reset_run_state()
 
 
 def span(name: str, cat: str = "phase", **args) -> Span:

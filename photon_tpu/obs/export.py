@@ -159,11 +159,12 @@ def export_artifacts(
     meta: dict | None = None,
 ) -> dict:
     """Write the full artifact set under ``directory`` — Chrome trace,
-    metrics snapshot, JSONL manifest, and the per-phase summary table —
-    and return ``{"trace", "metrics", "manifest", "summary"}`` paths.
-    ``prefix`` namespaces the filenames (bench writes one set per config
-    into a shared directory); the CLI drivers and bench both export
-    through here so the artifact layout cannot drift between them."""
+    metrics snapshot, JSONL manifest, the device-memory ledger report,
+    and the per-phase summary table — and return ``{"trace", "metrics",
+    "manifest", "memory", "summary"}`` paths. ``prefix`` namespaces the
+    filenames (bench writes one set per config into a shared directory);
+    the CLI drivers and bench both export through here so the artifact
+    layout cannot drift between them."""
     os.makedirs(directory, exist_ok=True)
 
     def _path(name: str) -> str:
@@ -177,12 +178,65 @@ def export_artifacts(
         "manifest": write_run_manifest(
             _path("manifest.jsonl"), tracer, registry, meta
         ),
+        "memory": write_memory_report(_path("memory_report.json"), meta),
     }
     summary_path = _path("summary.txt")
     with open(summary_path, "w") as f:
         f.write(summary_table(tracer) + "\n")
+        hist_block = histogram_summary(registry)
+        if hist_block:
+            f.write("\n" + hist_block + "\n")
     paths["summary"] = summary_path
     return paths
+
+
+def write_memory_report(path, meta: dict | None = None) -> str:
+    """The device-memory ledger (photon_tpu/obs/memory.py) as one JSON
+    document: per-executable static footprints, phase-boundary live
+    censuses with the peak high-watermark, and the H2D/D2H transfer
+    bill."""
+    from photon_tpu.obs import memory as obs_memory
+
+    with open(path, "w") as f:
+        json.dump(
+            _json_safe(
+                {**(meta or {}), "memory": obs_memory.get_ledger().report()}
+            ),
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+    return str(path)
+
+
+def histogram_summary(registry=None) -> str:
+    """Human-readable histogram table with the streaming pNN summaries
+    (p50/p90/p99 from the sparse log buckets) — appended to the
+    ``.summary.txt`` artifact so latency distributions (e.g.
+    ``score.batch_seconds``) are readable without parsing metrics.json."""
+    from photon_tpu.obs.metrics import SUMMARY_PERCENTILES
+
+    _, registry = _resolve(None, registry)
+    hists = registry.snapshot()["histograms"]
+    if not hists:
+        return ""
+    rows = sorted(hists.items())
+    width = max(len(name) for name, _ in rows)
+    pcols = "".join(f" {'p' + str(p):>10}" for p in SUMMARY_PERCENTILES)
+    lines = [
+        f"{'histogram':<{width}} {'count':>7} {'mean':>10}{pcols} {'max':>10}"
+    ]
+    for name, h in rows:
+        mean = h["sum"] / h["count"] if h["count"] else 0.0
+        pvals = "".join(
+            f" {h.get('p' + str(p)) or 0.0:>10.4g}"
+            for p in SUMMARY_PERCENTILES
+        )
+        lines.append(
+            f"{name:<{width}} {h['count']:>7} {mean:>10.4g}{pvals} "
+            f"{h['max']:>10.4g}"
+        )
+    return "\n".join(lines)
 
 
 def phase_summary(tracer=None) -> dict:

@@ -1,0 +1,284 @@
+"""Device-memory ledger: static footprints, live censuses, transfer bytes.
+
+The headline claim of the reference system — GAME models with hundreds of
+billions of coefficients — is a *capacity* claim, and both the mesh-
+sharded training and out-of-core streaming roadmap items stall without
+knowing what actually occupies device memory per coordinate, per
+executable, and per batch shape. This module gives the telemetry spine
+its *space* axis (PR 4 gave it time and work):
+
+- **Static footprints** (:meth:`MemoryLedger.record_executable`): every
+  AOT-compiled executable — the fused sweep/score programs
+  ``descent.precompile_coordinates`` builds and the per-batch-shape
+  programs ``GameScorer.precompile`` builds — reports XLA's own
+  ``compiled.memory_analysis()`` (argument / output / temp /
+  generated-code bytes). This is the compiler's accounting, not an
+  estimate: the per-coordinate, per-batch-shape table of what a program
+  NEEDS before it runs.
+- **Live censuses** (:meth:`MemoryLedger.census`): ``jax.live_arrays()``
+  grouped by (shape, dtype, sharding kind) with summed bytes, taken at
+  PHASE BOUNDARIES only (data build, precompile, warm start, the
+  per-sweep barrier, stream start/end — never inside the hot loop).
+  A census is pure host metadata: it enumerates the client's live
+  buffer handles and reads ``shape``/``dtype``/``nbytes`` attributes —
+  no device dispatch, no read-back — so enabling the ledger cannot
+  change a run's dispatch or barrier profile (pinned by test).
+  Censuses drive the ``mem.live_bytes`` gauge and the
+  ``mem.peak_bytes`` high-watermark.
+- **Transfer counters** (:meth:`MemoryLedger.count_h2d` /
+  :meth:`count_d2h`): bytes crossing the host/device boundary at the
+  known choke points (coordinate-build placement, scoring ingest,
+  scoring read-back, the ``util/force`` barrier) — the streaming
+  engines' residency claims become measured, not asserted.
+
+Gating: censuses and transfer counters are live only while the obs
+pipeline is enabled AND ``PHOTON_OBS_MEM`` is not ``0``. Executable
+footprints are ALWAYS recorded (a tiny dict per compile, at compile
+time — never on a hot path): they describe process-lifetime compiled
+programs, so they also survive :func:`photon_tpu.obs.reset` artifact
+boundaries (a scorer precompiled before ``obs.enable()`` still appears
+in the report). ``clear()`` drops everything.
+
+The whole ledger exports as ``memory_report.json`` through
+``obs.export_artifacts`` — one file per run next to the trace/metrics/
+manifest set.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "MemoryLedger",
+    "census",
+    "count_d2h",
+    "count_h2d",
+    "enabled",
+    "get_ledger",
+    "record_executable",
+]
+
+#: how many (shape, dtype, sharding) groups a census row keeps, largest
+#: first — enough to see what dominates without serializing thousands of
+#: tiny groups into every report
+CENSUS_TOP_GROUPS = 20
+
+
+def _sharding_kind(arr) -> str:
+    """Compact sharding label for grouping ("SingleDeviceSharding",
+    "NamedSharding(('data',))", ...) — never raises."""
+    try:
+        sh = arr.sharding
+        kind = type(sh).__name__
+        spec = getattr(sh, "spec", None)
+        return f"{kind}{tuple(spec)}" if spec is not None else kind
+    except Exception:
+        return "unknown"
+
+
+class MemoryLedger:
+    """Thread-safe device-memory accounting (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: label → static footprint from compiled.memory_analysis()
+        self._executables: dict[str, dict] = {}
+        #: phase-boundary census rows, in order
+        self._censuses: list[dict] = []
+        self._peak_bytes = 0
+        self._h2d_bytes = 0
+        self._d2h_bytes = 0
+
+    # -- static footprints --------------------------------------------------
+
+    def record_executable(self, label: str, compiled) -> dict:
+        """Record XLA's per-executable memory analysis under ``label``
+        (e.g. ``"user:sweep"``, ``"score:(('global', 8),)"``). Returns
+        the entry. A backend without the analysis (or a failing call)
+        records an ``error`` entry instead of raising — the ledger must
+        never break a compile."""
+        try:
+            ma = compiled.memory_analysis()
+            entry = {
+                "argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "temp_bytes": int(ma.temp_size_in_bytes),
+                "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+                "alias_bytes": int(ma.alias_size_in_bytes),
+            }
+            entry["total_bytes"] = (
+                entry["argument_bytes"]
+                + entry["output_bytes"]
+                + entry["temp_bytes"]
+                + entry["generated_code_bytes"]
+            )
+        except Exception as e:  # analysis unavailable on this backend
+            entry = {"error": f"{type(e).__name__}: {e}"}
+        with self._lock:
+            self._executables[label] = entry
+        return entry
+
+    # -- live censuses ------------------------------------------------------
+
+    def census(self, phase: str) -> dict | None:
+        """One live-buffer census row at a phase boundary: every
+        ``jax.live_arrays()`` handle grouped by (shape, dtype, sharding
+        kind), bytes summed. Host metadata only — no device work."""
+        import jax
+
+        groups: dict[tuple, dict] = {}
+        total = 0
+        n = 0
+        for arr in jax.live_arrays():
+            try:
+                nbytes = int(arr.nbytes)
+                key = (str(arr.dtype), tuple(arr.shape), _sharding_kind(arr))
+            except Exception:
+                continue  # a half-deleted handle must not kill the census
+            n += 1
+            total += nbytes
+            g = groups.setdefault(
+                key, {"count": 0, "bytes": 0}
+            )
+            g["count"] += 1
+            g["bytes"] += nbytes
+        top = sorted(groups.items(), key=lambda kv: -kv[1]["bytes"])
+        row = {
+            "phase": phase,
+            "live_bytes": total,
+            "n_arrays": n,
+            "n_groups": len(groups),
+            "groups": [
+                {
+                    "dtype": k[0],
+                    "shape": list(k[1]),
+                    "sharding": k[2],
+                    **v,
+                }
+                for k, v in top[:CENSUS_TOP_GROUPS]
+            ],
+        }
+        with self._lock:
+            self._censuses.append(row)
+            self._peak_bytes = max(self._peak_bytes, total)
+        from photon_tpu import obs
+
+        obs.counter("mem.censuses")
+        obs.gauge("mem.live_bytes", total)
+        obs.gauge("mem.peak_bytes", self._peak_bytes)
+        return row
+
+    # -- transfer counters --------------------------------------------------
+
+    def count_h2d(self, nbytes: int) -> None:
+        with self._lock:
+            self._h2d_bytes += int(nbytes)
+        from photon_tpu import obs
+
+        obs.counter("mem.h2d_bytes", int(nbytes))
+
+    def count_d2h(self, nbytes: int) -> None:
+        with self._lock:
+            self._d2h_bytes += int(nbytes)
+        from photon_tpu import obs
+
+        obs.counter("mem.d2h_bytes", int(nbytes))
+
+    # -- reading ------------------------------------------------------------
+
+    def report(self) -> dict:
+        """The full ledger as plain JSON-serializable data — what
+        ``memory_report.json`` holds."""
+        with self._lock:
+            execs = {k: dict(v) for k, v in self._executables.items()}
+            rows = [dict(r) for r in self._censuses]
+            peak = self._peak_bytes
+            h2d, d2h = self._h2d_bytes, self._d2h_bytes
+        ok = [v for v in execs.values() if "error" not in v]
+        return {
+            "executables": execs,
+            "executables_total": {
+                "n": len(execs),
+                "n_analyzed": len(ok),
+                "argument_bytes": sum(v["argument_bytes"] for v in ok),
+                "output_bytes": sum(v["output_bytes"] for v in ok),
+                "temp_bytes": sum(v["temp_bytes"] for v in ok),
+                "generated_code_bytes": sum(
+                    v["generated_code_bytes"] for v in ok
+                ),
+            },
+            "censuses": rows,
+            "peak_live_bytes": peak,
+            "h2d_bytes": h2d,
+            "d2h_bytes": d2h,
+        }
+
+    def reset_run_state(self) -> None:
+        """Artifact boundary (``obs.reset``): drop censuses and transfer
+        counters, KEEP the executable table — static footprints describe
+        process-lifetime compiled programs, and a scorer precompiled
+        before ``obs.enable()`` must still appear in the next report."""
+        with self._lock:
+            self._censuses.clear()
+            self._peak_bytes = 0
+            self._h2d_bytes = 0
+            self._d2h_bytes = 0
+
+    def clear(self) -> None:
+        """Full clear, executable table included (tests own this)."""
+        with self._lock:
+            self._executables.clear()
+        self.reset_run_state()
+
+
+_ledger = MemoryLedger()
+
+
+def get_ledger() -> MemoryLedger:
+    return _ledger
+
+
+def enabled() -> bool:
+    """Censuses/transfer counters are live while the obs pipeline is on
+    and ``PHOTON_OBS_MEM`` is not ``0`` (executable footprints record
+    unconditionally — see module docstring)."""
+    from photon_tpu import obs
+
+    return obs.enabled() and os.environ.get(
+        "PHOTON_OBS_MEM", ""
+    ).strip() != "0"
+
+
+def record_executable(label: str, compiled) -> dict:
+    return _ledger.record_executable(label, compiled)
+
+
+def census(phase: str) -> dict | None:
+    """Module-level census on the default ledger — a no-op while the
+    ledger is gated off, so phase-boundary call sites stay one-liners
+    with zero cost in unprofiled runs."""
+    if not enabled():
+        return None
+    return _ledger.census(phase)
+
+
+def count_h2d(nbytes: int) -> None:
+    if enabled() and nbytes:
+        _ledger.count_h2d(nbytes)
+
+
+def count_d2h(nbytes: int) -> None:
+    if enabled() and nbytes:
+        _ledger.count_d2h(nbytes)
+
+
+def tree_device_bytes(tree) -> int:
+    """Σ ``nbytes`` over the jax.Array leaves of ``tree`` — the h2d bill
+    of a placement call site, computed from handle metadata (free)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            total += int(getattr(leaf, "nbytes", 0))
+    return total

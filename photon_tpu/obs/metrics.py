@@ -6,9 +6,13 @@ docs/DESIGN.md §Observability). Three instrument kinds:
 
 - **counter** — monotonic accumulator (int or float increments);
 - **gauge** — last-write-wins scalar;
-- **histogram** — streaming count/sum/min/max of observed samples (no
-  sample buffer: bench sweeps observe thousands of values, and the
-  moments are what the regression gate bands).
+- **histogram** — streaming count/sum/min/max PLUS sparse log-spaced
+  bucket counts of observed samples (no sample buffer: bench sweeps and
+  the serving loop observe thousands of values). The buckets make
+  p50–p99 summaries (:meth:`MetricsRegistry.percentile`) available at
+  ~5% relative resolution — the latency-SLO prerequisite for the
+  always-on serving roadmap item (``score.batch_seconds`` tail
+  latency), at O(log range) memory per histogram.
 
 ``snapshot()`` returns plain JSON-serializable dicts; ``delta()`` diffs
 two snapshots fieldwise so callers can attribute counters to a region
@@ -16,7 +20,52 @@ the way ``compile_watch`` deltas do.
 """
 from __future__ import annotations
 
+import math
 import threading
+
+#: log-bucket growth factor: each bucket spans ×1.1 of value range, so a
+#: percentile read is within ~±5% of the true sample value — plenty for
+#: latency SLOs, bounded memory for any value range
+_BUCKET_BASE = 1.1
+_LOG_BASE = math.log(_BUCKET_BASE)
+
+#: percentiles the snapshot (and the .summary.txt exporter) report
+SUMMARY_PERCENTILES = (50, 90, 99)
+
+
+def _bucket_index(value: float) -> int:
+    """Sparse log-bucket index; values ≤ 0 share the floor bucket (a
+    latency/bytes histogram never legitimately goes negative)."""
+    if value <= 0:
+        return -(10**6)
+    return math.floor(math.log(value) / _LOG_BASE)
+
+
+def _bucket_value(index: int) -> float:
+    """Representative (geometric-midpoint) value of a bucket."""
+    if index == -(10**6):
+        return 0.0
+    return _BUCKET_BASE ** (index + 0.5)
+
+
+def percentile_from_buckets(h: dict, q: float) -> float | None:
+    """The q-th percentile (0–100) from a histogram's snapshot dict —
+    exposed as a function so exporters and offline consumers of
+    ``metrics.json`` can summarize without a live registry."""
+    count = h.get("count", 0)
+    buckets = h.get("buckets")
+    if not count or not buckets:
+        return None
+    target = max(1, math.ceil(count * q / 100.0))
+    seen = 0
+    for idx in sorted(int(k) for k in buckets):
+        seen += buckets[str(idx)] if str(idx) in buckets else buckets[idx]
+        if seen >= target:
+            # clamp into the observed range: the log-midpoint of the
+            # extreme buckets can overshoot the true min/max
+            v = _bucket_value(idx)
+            return min(max(v, h.get("min", v)), h.get("max", v))
+    return h.get("max")
 
 
 class MetricsRegistry:
@@ -48,23 +97,45 @@ class MetricsRegistry:
                     "sum": 0.0,
                     "min": value,
                     "max": value,
+                    "buckets": {},
                 }
             h["count"] += 1
             h["sum"] += value
             h["min"] = min(h["min"], value)
             h["max"] = max(h["max"], value)
+            # string keys: the snapshot must round-trip through JSON
+            # without the int→str key coercion changing its shape
+            b = str(_bucket_index(value))
+            h["buckets"][b] = h["buckets"].get(b, 0) + 1
 
     # -- reading -----------------------------------------------------------
 
+    def percentile(self, name: str, q: float) -> float | None:
+        """q-th percentile (0–100) of histogram ``name`` from its sparse
+        log buckets (±~5% relative resolution); None when unobserved."""
+        with self._lock:
+            h = self._hists.get(name)
+            h = None if h is None else dict(h, buckets=dict(h["buckets"]))
+        return None if h is None else percentile_from_buckets(h, q)
+
     def snapshot(self) -> dict:
         """``{"counters": {...}, "gauges": {...}, "histograms": {...}}`` —
-        plain data, safe to json.dumps."""
+        plain data, safe to json.dumps. Histogram entries carry their
+        streaming moments, the sparse buckets, and pNN summaries."""
         with self._lock:
-            return {
+            hists = {
+                k: dict(v, buckets=dict(v["buckets"]))
+                for k, v in self._hists.items()
+            }
+            out = {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
-                "histograms": {k: dict(v) for k, v in self._hists.items()},
+                "histograms": hists,
             }
+        for h in out["histograms"].values():
+            for p in SUMMARY_PERCENTILES:
+                h[f"p{p}"] = percentile_from_buckets(h, p)
+        return out
 
     def clear(self) -> None:
         with self._lock:

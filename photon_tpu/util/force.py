@@ -18,12 +18,26 @@ round trip total.
 """
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Sequence
 
 import jax
 import numpy as np
 
-__all__ = ["force"]
+__all__ = ["fetch_scalars", "force"]
+
+
+def _count_d2h(nbytes: int) -> None:
+    """Mirror the barrier's actual device→host traffic into the memory
+    ledger (photon_tpu/obs/memory.py) — a no-op unless the ledger is
+    live. The barrier reads ~4 bytes per leaf, and counting it keeps the
+    ``mem.d2h_bytes`` ledger honest about EVERY crossing, not just the
+    big ones."""
+    try:
+        from photon_tpu.obs import memory as obs_memory
+
+        obs_memory.count_d2h(nbytes)
+    except Exception:
+        pass  # telemetry must never break the barrier
 
 
 def _multi_device(leaf) -> bool:
@@ -55,6 +69,7 @@ def force(tree: Any) -> None:
     ]
     if not leaves:
         return
+    _count_d2h(4 * len(leaves))  # one element per leaf crosses back
     if len(leaves) == 1:
         np.asarray(leaves[0].reshape(-1)[0:1])
         return
@@ -92,3 +107,77 @@ def force(tree: Any) -> None:
         # round trip each, but correct).
         for leaf in rest:
             np.asarray(leaf.reshape(-1)[0:1])
+
+
+def fetch_scalars(scalars: Sequence[Any], barrier: Any = None) -> np.ndarray:
+    """Read back a flat sequence of device scalars as float32 values in
+    ONE device→host round trip, optionally ALSO serving as the
+    completion barrier for ``barrier`` (see :func:`force`) in that same
+    fetch.
+
+    This is how descent's health monitor stays sync-free: the sweep's
+    honest read-back barrier and the per-coordinate health scalars
+    (loss / grad-norm / isfinite sentinel, all 0-d outputs of the
+    already-dispatched sweep programs) travel together — folding health
+    into the barrier adds ZERO read-backs and zero dispatches to the
+    steady state. Booleans come back as 1.0/0.0.
+
+    Non-device scalars (plain Python/numpy numbers in mixed trees) pass
+    through without touching the device.
+    """
+    import jax.numpy as jnp
+
+    scalars = list(scalars)
+    barrier_leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(barrier)
+        if isinstance(leaf, jax.Array) and int(getattr(leaf, "size", 0))
+    ]
+    pieces = []
+    for leaf in barrier_leaves:
+        if _multi_device(leaf):
+            # genuinely multi-device leaves barrier separately (the
+            # concatenated fetch must never introduce collectives — see
+            # force() above); everything else rides the single fetch
+            _count_d2h(4)
+            np.asarray(leaf.reshape(-1)[0:1])
+        else:
+            pieces.append(leaf.reshape(-1)[0:1].astype(jnp.float32))
+    n_barrier = len(pieces)
+    host_at: dict[int, float] = {}
+    for i, s in enumerate(scalars):
+        if not isinstance(s, jax.Array):
+            host_at[i] = float(s)
+        elif _multi_device(s):
+            # same collective-freedom rule as the barrier leaves: a
+            # multi-device (replicated-under-mesh) scalar must be read
+            # from its owning devices directly, never concatenated into
+            # a cross-device program (force() documents the rendezvous
+            # hard-abort that produces — not a catchable exception)
+            _count_d2h(4)
+            host_at[i] = float(np.asarray(s.reshape(-1)[0:1])[0])
+        else:
+            pieces.append(s.reshape(-1)[0:1].astype(jnp.float32))
+    if pieces:
+        _count_d2h(4 * len(pieces))
+        try:
+            fetched = np.asarray(jnp.concatenate(pieces))
+        except Exception:
+            # mixed-device/platform trees: per-piece fetch keeps the
+            # barrier AND the values correct at a round trip per piece
+            fetched = np.concatenate(
+                [np.asarray(p, dtype=np.float32) for p in pieces]
+            )
+        fetched = fetched[n_barrier:]
+    else:
+        fetched = np.zeros(0, dtype=np.float32)
+    # reassemble in caller order: device values in fetch order, host
+    # values at their recorded positions
+    it = iter(fetched)
+    return np.asarray(
+        [
+            host_at[i] if i in host_at else float(next(it))
+            for i in range(len(scalars))
+        ],
+        dtype=np.float32,
+    )

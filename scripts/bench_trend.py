@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Cross-run bench trend gate: the perf trajectory as a first-class artifact.
+
+The repo accumulates one ``BENCH_r*.json`` per perf round, in two driver
+formats (a wrapper with the payload under ``parsed`` — often lost to the
+driver's stdout-tail truncation — and the raw cumulative payload bench.py
+itself emits). Until now reading the trajectory meant hand-diffing loose
+JSON; this script makes it mechanical:
+
+1. **Ingest** every history file (default: ``BENCH_r*.json`` in the repo
+   root) plus an optional fresh run (``--fresh``, default
+   ``BENCH_partial.json`` when present), tolerant of both formats and of
+   failed rounds (r01/r02 carry no payload — they appear in the table as
+   unparseable, they never crash the gate).
+2. **Align** rows by config name and only ever compare rows with the
+   same (``backend``, ``scale``, ``metric_version``) — a CPU-fallback
+   smoke row must never read as a regression against a TPU row, and a
+   metric-version bump (what a number COUNTS changed — see
+   ``bench.METRIC_VERSION``) splits the series instead of lying across
+   it.
+3. **Verdict**: the fresh run's rows pass through the same
+   ``QUALITY_BANDS`` gate the orchestrator applies
+   (``bench.check_quality_bands`` — one tolerance source, not a second
+   copy), and each fresh row is compared against the LATEST comparable
+   historical row: a drop beyond ``--tolerance`` (default 25%, generous
+   to same-machine noise — PERF.md r6 measured ±25% wall noise on the
+   2-core builder) is a regression.
+
+Exit status: 0 = healthy (including "nothing comparable"), 3 = the
+fresh run violates a quality band or regresses beyond tolerance.
+``--out`` writes the machine-readable trend document CI uploads.
+
+Usage::
+
+    python scripts/bench_trend.py                        # history table only
+    python scripts/bench_trend.py --fresh BENCH_partial.json --out trend.json
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: per-config columns the trajectory table shows (first present wins for
+#: the memory column — pre-v4 rows simply show "-")
+MEM_KEYS = ("peak_bytes", "exec_temp_bytes")
+
+
+def extract_payload(doc: dict) -> dict | None:
+    """The cumulative bench payload out of either driver format:
+    top-level ``configs`` (bench.py's own emission), the wrapper's
+    ``parsed`` field, or a JSON line buried in the wrapper's truncated
+    ``tail``. None when the round carried no parseable payload."""
+    if isinstance(doc.get("configs"), dict):
+        return doc
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("configs"), dict):
+        return parsed
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand.get("configs"), dict):
+                return cand
+    return None
+
+
+def load_round(path: str) -> dict:
+    """One history entry: ``{"round", "path", "payload"|"error"}``."""
+    name = os.path.splitext(os.path.basename(path))[0]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"round": name, "path": path, "error": f"unreadable: {e}"}
+    payload = extract_payload(doc)
+    if payload is None:
+        rc = doc.get("rc")
+        return {
+            "round": name,
+            "path": path,
+            "error": f"no parseable bench payload (driver rc={rc!r} — "
+            "failed round or tail-truncated stdout)",
+        }
+    return {"round": name, "path": path, "payload": payload}
+
+
+def config_rows(entry: dict) -> dict[str, dict]:
+    """config name → flat comparable row for one loaded round."""
+    payload = entry.get("payload")
+    if not payload:
+        return {}
+    out = {}
+    for name, cfg in payload.get("configs", {}).items():
+        if not isinstance(cfg, dict) or "error" in cfg:
+            continue
+        mem = cfg.get("mem") or {}
+        out[name] = {
+            "round": entry["round"],
+            "metric_version": payload.get("metric_version")
+            or cfg.get("metric_version"),
+            "backend": cfg.get("backend"),
+            "scale": cfg.get("scale"),
+            "examples_per_sec": cfg.get("examples_per_sec"),
+            "mem": {k: mem.get(k) for k in MEM_KEYS},
+            "detail": cfg,
+        }
+    return out
+
+
+def _series_key(row: dict) -> tuple:
+    return (row.get("backend"), row.get("scale"), row.get("metric_version"))
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024
+    return str(v)
+
+
+def trajectory_table(series: dict[str, list[dict]]) -> str:
+    """Per-config trajectory, one line per (round, series) row."""
+    lines = []
+    for name in sorted(series):
+        lines.append(f"== {name}")
+        lines.append(
+            f"  {'round':<18} {'mv':>3} {'backend':>8} {'scale':>6} "
+            f"{'examples/sec':>14} {'mem.peak':>10} {'exec.temp':>10}"
+        )
+        for row in series[name]:
+            eps = row["examples_per_sec"]
+            lines.append(
+                f"  {row['round']:<18} "
+                f"{str(row['metric_version'] or '-'):>3} "
+                f"{str(row['backend'] or '-'):>8} "
+                f"{str(row['scale'] or '-'):>6} "
+                f"{eps if eps is not None else '-':>14} "
+                f"{_fmt_bytes(row['mem'].get('peak_bytes')):>10} "
+                f"{_fmt_bytes(row['mem'].get('exec_temp_bytes')):>10}"
+            )
+    return "\n".join(lines)
+
+
+def judge_fresh(
+    fresh_rows: dict[str, dict],
+    series: dict[str, list[dict]],
+    tolerance: float,
+) -> list[dict]:
+    """Verdict rows for every fresh config: quality bands (the SAME
+    tolerances the bench orchestrator enforces) + trend vs the latest
+    comparable historical row."""
+    from bench import check_quality_bands
+
+    verdicts = []
+    for name, row in sorted(fresh_rows.items()):
+        v: dict = {"config": name, "status": "ok", "notes": []}
+        violations = check_quality_bands(name, row["detail"])
+        if violations:
+            v["status"] = "fail"
+            v["notes"].extend(f"quality band: {x}" for x in violations)
+        prior = [
+            r
+            for r in series.get(name, [])
+            if _series_key(r) == _series_key(row)
+            and r["examples_per_sec"] is not None
+            and r["round"] != row["round"]
+        ]
+        eps = row["examples_per_sec"]
+        if not prior or eps is None:
+            v["notes"].append(
+                "no comparable history row (backend/scale/metric_version "
+                "series starts here)"
+            )
+        else:
+            base = prior[-1]
+            ratio = eps / base["examples_per_sec"]
+            v["vs"] = {
+                "round": base["round"],
+                "examples_per_sec": base["examples_per_sec"],
+                "ratio": round(ratio, 3),
+            }
+            if ratio < 1.0 - tolerance:
+                v["status"] = "fail"
+                v["notes"].append(
+                    f"examples_per_sec regressed {ratio:.2f}x vs "
+                    f"{base['round']} (tolerance {1.0 - tolerance:.2f}x)"
+                )
+        verdicts.append(v)
+    return verdicts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--history",
+        default=os.path.join(_REPO_ROOT, "BENCH_r*.json"),
+        help="glob of committed bench round files",
+    )
+    ap.add_argument(
+        "--fresh",
+        default=None,
+        help="a fresh run to gate (default: BENCH_partial.json when it "
+        "exists; the fresh run also joins the printed trajectory)",
+    )
+    ap.add_argument(
+        "--tolerance",
+        type=float,
+        default=0.25,
+        help="allowed fractional examples_per_sec drop vs the latest "
+        "comparable row (default 0.25)",
+    )
+    ap.add_argument("--out", default=None, help="write the trend JSON here")
+    args = ap.parse_args(argv)
+
+    paths = sorted(glob.glob(args.history))
+    entries = [load_round(p) for p in paths]
+    skipped = [e for e in entries if "error" in e]
+    series: dict[str, list[dict]] = {}
+    for e in entries:
+        for name, row in config_rows(e).items():
+            series.setdefault(name, []).append(row)
+
+    fresh_path = args.fresh
+    if fresh_path is None:
+        default_fresh = os.path.join(_REPO_ROOT, "BENCH_partial.json")
+        fresh_path = default_fresh if os.path.exists(default_fresh) else None
+    fresh_rows: dict[str, dict] = {}
+    verdicts: list[dict] = []
+    if fresh_path is not None:
+        fresh_entry = load_round(fresh_path)
+        fresh_entry["round"] = f"fresh:{fresh_entry['round']}"
+        if "error" in fresh_entry:
+            print(f"FRESH RUN UNREADABLE: {fresh_entry['error']}")
+            return 3
+        for name, row in config_rows(fresh_entry).items():
+            row["round"] = fresh_entry["round"]
+            fresh_rows[name] = row
+        verdicts = judge_fresh(fresh_rows, series, args.tolerance)
+        for name, row in fresh_rows.items():
+            series.setdefault(name, []).append(row)
+
+    print(trajectory_table(series) or "(no parseable bench rounds)")
+    for e in skipped:
+        print(f"-- skipped {e['round']}: {e['error']}")
+    failed = [v for v in verdicts if v["status"] == "fail"]
+    for v in verdicts:
+        marker = "FAIL" if v["status"] == "fail" else "ok"
+        notes = "; ".join(v["notes"]) if v["notes"] else ""
+        vs = v.get("vs")
+        trend = f" {vs['ratio']}x vs {vs['round']}" if vs else ""
+        print(f"[{marker}] {v['config']}{trend} {notes}".rstrip())
+
+    if args.out:
+        doc = {
+            "rounds": [e["round"] for e in entries],
+            "skipped": [
+                {"round": e["round"], "error": e["error"]} for e in skipped
+            ],
+            "series": {
+                name: [
+                    {k: r[k] for k in r if k != "detail"} for r in rows
+                ]
+                for name, rows in series.items()
+            },
+            "verdicts": verdicts,
+            "tolerance": args.tolerance,
+        }
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+        print(f"wrote trend document to {args.out}")
+
+    return 3 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

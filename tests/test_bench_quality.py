@@ -91,15 +91,20 @@ def test_poisoned_game_scores_fail_auc_band():
     ids = np.asarray([f"u{i}" for i in rng.integers(0, users, size=n)])
     margin = rng.normal(size=n) * 2.0
     labels = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    # mem columns present and sane: this test targets the AUC band, and
+    # GAME configs now also require the memory-ledger columns (PR 7)
+    mem = {"peak_bytes": 1 << 20, "exec_temp_bytes": 1 << 10}
     healthy = {
         "scale": "cpu",
         "grouped_auc": {"value": _grouped_auc(margin, labels, ids)},
+        "mem": mem,
     }
     # the poison: a sign flip in the scoring path — the classic silent
     # model-assembly bug a throughput metric would never notice
     poisoned = {
         "scale": "cpu",
         "grouped_auc": {"value": _grouped_auc(-margin, labels, ids)},
+        "mem": mem,
     }
     assert bench.check_quality_bands("game_ctr_scale", healthy) == []
     violations = bench.check_quality_bands("game_ctr_scale", poisoned)

@@ -180,7 +180,7 @@ def test_fused_sweep_donation_mode_and_no_warnings():
     total = jnp.array(np.asarray(score))  # independent buffer
     with warnings.catch_warnings(record=True) as rec:
         warnings.simplefilter("always")
-        new_state, new_score, new_total, info = fe.sweep_step(
+        new_state, new_score, new_total, info, health = fe.sweep_step(
             total, score, state
         )
         np.asarray(new_total)

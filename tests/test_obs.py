@@ -166,17 +166,50 @@ def test_metrics_registry_and_delta():
     snap = reg.snapshot()
     assert snap["counters"]["a"] == 3
     assert snap["gauges"]["g"] == 7.5
-    assert snap["histograms"]["h"] == {
-        "count": 3,
-        "sum": 6.0,
-        "min": 1.0,
-        "max": 3.0,
-    }
+    h = snap["histograms"]["h"]
+    assert (h["count"], h["sum"], h["min"], h["max"]) == (3, 6.0, 1.0, 3.0)
+    assert sum(h["buckets"].values()) == 3  # every sample lands a bucket
     reg.counter("a", 4)
     reg.counter("b")
     d = MetricsRegistry.delta(snap, reg.snapshot())
     assert d["counters"] == {"a": 4, "b": 1}
     json.dumps(snap)  # snapshot must be plain data
+
+
+def test_histogram_percentiles_within_bucket_resolution():
+    """Satellite: streaming p50–p99 from the sparse log buckets must
+    land within the documented ~±5% relative resolution, at bounded
+    memory (no sample buffer)."""
+    reg = MetricsRegistry()
+    values = [0.001 * (i + 1) for i in range(1000)]  # 1ms … 1s
+    for v in values:
+        reg.histogram("lat", v)
+    for q in (50, 90, 99):
+        true = values[int(len(values) * q / 100) - 1]
+        got = reg.percentile("lat", q)
+        assert abs(got - true) / true < 0.06, (q, got, true)
+    # percentile clamps into the observed range at the extremes
+    assert reg.percentile("lat", 100) <= max(values)
+    assert reg.percentile("lat", 0.1) >= min(values)
+    assert reg.percentile("nope", 50) is None
+    # snapshot carries the pNN summaries the exporters render
+    h = reg.snapshot()["histograms"]["lat"]
+    assert h["p50"] == reg.percentile("lat", 50)
+    # bounded memory: 3 decades of range stay at O(log range) buckets
+    assert len(h["buckets"]) < 80
+
+
+def test_histogram_summary_renders_percentiles():
+    from photon_tpu.obs.export import histogram_summary
+
+    reg = MetricsRegistry()
+    for v in (0.01, 0.02, 0.04):
+        reg.histogram("score.batch_seconds", v)
+    table = histogram_summary(reg)
+    assert "score.batch_seconds" in table
+    for col in ("p50", "p90", "p99", "count", "mean"):
+        assert col in table
+    assert histogram_summary(MetricsRegistry()) == ""
 
 
 def test_global_instruments_gated_by_enable():
@@ -353,12 +386,20 @@ def test_disabled_tracer_is_dispatch_and_readback_neutral(monkeypatch):
 
     forces = {"n": 0}
     real_force = descent_mod.force
+    real_fetch = descent_mod.fetch_scalars
 
     def counting_force(*a, **kw):
         forces["n"] += 1
         return real_force(*a, **kw)
 
+    def counting_fetch(*a, **kw):
+        # the sweep barrier is a fetch_scalars since the health monitor
+        # folded into it — it IS the read-back, so it counts as one
+        forces["n"] += 1
+        return real_fetch(*a, **kw)
+
     monkeypatch.setattr(descent_mod, "force", counting_force)
+    monkeypatch.setattr(descent_mod, "fetch_scalars", counting_fetch)
 
     def run(enabled):
         obs.reset()
