@@ -6,11 +6,16 @@ Two engines, one gate:
   in a bug class this repo shipped: PHL001 donated-view aliasing (PR 2),
   PHL002 host-sync in hot paths, PHL003 thread/queue lifecycles (PR 5),
   PHL004 ctypes temporary-buffer pools (PR 3), PHL005 jit retrace
-  hazards, PHL006 wall-clock durations.
-* program checks (``analysis.hlo``) over lowered/compiled XLA modules:
-  collective-freedom, constant-embedding bounds, and the solve-shape
-  census against the PR 3 shape budget — runnable over every
-  AOT-precompiled executable of a fit, not just test fixtures.
+  hazards, PHL006 wall-clock durations, PHL007 un-sharded device
+  placements in mesh-scoped code, PHL008 ``shard_map`` without explicit
+  ``out_specs`` (both PR 9, the SPMD contract layer).
+* program checks (``analysis.hlo`` + ``analysis.spmd``) over
+  lowered/compiled XLA modules: the priced communication census with
+  per-coordinate allowances, sharding contracts (replicated-table and
+  lost-partitioning detection), constant-embedding bounds, and the
+  solve-shape census against the PR 3 shape budget — runnable over
+  every AOT-precompiled executable of a fit AND the streaming scorer,
+  not just test fixtures.
 
 Run locally with ``python -m photon_tpu.analysis``; the catalog and the
 allowlist policy live in docs/DESIGN.md §Static analysis.

@@ -37,11 +37,11 @@ class BaselineEntry:
         return f"{self.path}: {self.rule}\n    {self.snippet}"
 
 
-def _parse_toml_subset(text: str) -> list[dict]:
+def _parse_toml_subset(text: str) -> list[dict[str, str]]:
     """[[allow]] tables of string key/values; raises ValueError on
     anything outside the subset the writer emits."""
-    tables: list[dict] = []
-    current: dict | None = None
+    tables: list[dict[str, str]] = []
+    current: dict[str, str] | None = None
     for i, raw in enumerate(text.splitlines(), start=1):
         line = raw.strip()
         if not line or line.startswith("#"):
@@ -74,7 +74,7 @@ def load_baseline(path: Path) -> list[BaselineEntry]:
         tables = tomllib.loads(text).get("allow", [])
     except ModuleNotFoundError:  # Python 3.10
         tables = _parse_toml_subset(text)
-    out = []
+    out: list[BaselineEntry] = []
     for t in tables:
         out.append(
             BaselineEntry(

@@ -20,6 +20,7 @@ import json
 import sys
 from collections import Counter
 from pathlib import Path
+from typing import Any, Sequence
 
 from photon_tpu.analysis.baseline import (
     BaselineEntry,
@@ -43,10 +44,13 @@ def _find_root(start: Path) -> Path:
     return cur
 
 
-def build_canonical_fixture():
+def build_canonical_fixture(mesh: Any = None) -> dict[str, Any]:
     """A small two-coordinate (FE + RE) GAME build, precompiled — the
     program-check corpus. Deliberately tiny: the value is in auditing
-    EVERY program the fit dispatches, not in scale."""
+    EVERY program the fit dispatches, not in scale. With ``mesh`` the
+    same build spans it (entity-sharded RE blocks, row-sharded FE batch),
+    so the SPMD contract checks run against genuinely partitioned
+    programs."""
     import numpy as np
 
     from photon_tpu.game.config import (
@@ -96,40 +100,177 @@ def build_canonical_fixture():
         random_effect_type="userId", feature_shard="per_user",
         optimization=opt, regularization_weights=(1.0,),
     )
+    entity_shards = 1
+    if mesh is not None:
+        from photon_tpu.parallel.mesh import ENTITY_AXIS
+
+        entity_shards = mesh.shape[ENTITY_AXIS]
     coordinates = {
-        "global": build_coordinate(data, fe_cfg),
+        "global": build_coordinate(data, fe_cfg, mesh=mesh),
         "per_user": build_coordinate(
             data, re_cfg,
-            re_dataset=build_random_effect_dataset(data, re_cfg),
+            re_dataset=build_random_effect_dataset(
+                data, re_cfg, entity_shards=entity_shards
+            ),
+            mesh=mesh,
         ),
     }
     precompile_coordinates(coordinates)
     return coordinates
 
 
-def run_program_checks(jsonl_rows: list[dict]) -> int:
-    from photon_tpu.analysis.hlo import audit_coordinates
+def build_scorer_fixture(coordinates: dict[str, Any]) -> Any:
+    """A GameScorer over the canonical fixture's exported model, its
+    fused per-batch-shape program precompiled — the streaming engine's
+    executables join the audit corpus instead of staying the one
+    unaudited program family (PR 6 only covered
+    ``Coordinate.aot_executables``)."""
+    from photon_tpu.game.model import GameModel
+    from photon_tpu.game.scoring import GameScorer
+    from photon_tpu.types import TaskType
+
+    model = GameModel(
+        coordinates={
+            cid: coord.to_model(coord.initial_state())
+            for cid, coord in coordinates.items()
+        },
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+    scorer = GameScorer(model, batch_rows=128)
+    # the FE shard is dense-built at 32 columns → every row carries 32
+    # nonzeros → the one ELL width the streaming path would use
+    scorer.precompile({"global": 32})
+    return scorer
+
+
+def _mem_cell(footprint: dict[str, Any], key: str) -> str:
+    if not footprint or key not in footprint:
+        return "-"
+    return str(footprint[key])
+
+
+def print_program_table(reports: list[Any]) -> None:
+    """One per-executable compute/memory/comms line per audited program:
+    XLA's flop estimate, the PR 7 MemoryLedger footprint (argument/temp
+    bytes from ``compiled.memory_analysis()``), and the communication
+    census (collective sites + priced payload bytes)."""
+    from photon_tpu.obs import memory as obs_memory
+
+    footprints = obs_memory.executable_footprints()
+    rows = []
+    for report in reports:
+        for row in report.comm:
+            fp = footprints.get(row["ledger_label"]) or {}
+            sites = row["collective_sites"]
+            ops = sorted({s["op"] for s in sites})
+            rows.append(
+                (
+                    row["program"],
+                    "-" if row["flops"] is None else f"{row['flops']:.3g}",
+                    _mem_cell(fp, "argument_bytes"),
+                    _mem_cell(fp, "temp_bytes"),
+                    str(len(sites)),
+                    str(row["comm_bytes"]),
+                    ",".join(ops) if ops else "-",
+                )
+            )
+    header = (
+        "program", "flops", "arg_bytes", "temp_bytes",
+        "coll_sites", "comm_bytes", "ops",
+    )
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else
+        len(header[i])
+        for i in range(len(header))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print("[photon-lint] per-executable compute/memory/comms census:")
+    print("  " + fmt.format(*header))
+    for r in rows:
+        print("  " + fmt.format(*r))
+
+
+def run_program_checks(jsonl_rows: list[dict[str, Any]]) -> int:
+    from photon_tpu.analysis.hlo import audit_coordinates, audit_scorer
     from photon_tpu.game.data import re_shape_budget
 
-    coordinates = build_canonical_fixture()
-    report = audit_coordinates(
-        coordinates, shape_budget=re_shape_budget(None)
-    )
+    mesh = None
+    try:
+        import jax
+
+        if len(jax.devices()) >= 2:
+            from photon_tpu.parallel.mesh import make_mesh
+
+            # all devices on the entity axis: the RE table sharding and
+            # the FE row sharding both genuinely partition, so the
+            # contract checks run against real SPMD programs (the CI job
+            # provides the 8-virtual-device CPU platform)
+            mesh = make_mesh(num_data=1, num_entity=len(jax.devices()))
+    except Exception as e:
+        print(f"[photon-lint] WARNING: mesh probe failed ({e}); "
+              "auditing single-device programs only")
+    coordinates = build_canonical_fixture(mesh=mesh)
+    reports = [
+        audit_coordinates(coordinates, shape_budget=re_shape_budget(None))
+    ]
+    # a broken scorer build is itself a gate failure, but it must not
+    # MASK the coordinate audit: the census/finding rows collected so
+    # far still print and land in the --jsonl artifact either way
+    scorer_error: Exception | None = None
+    scorer_programs = 0
+    try:
+        scorer = build_scorer_fixture(coordinates)
+        reports.append(audit_scorer(scorer))
+        scorer_programs = reports[-1].programs_checked
+    except Exception as e:
+        scorer_error = e
+    programs = sum(r.programs_checked for r in reports)
+    findings = [pf for r in reports for pf in r.findings]
+    skipped = [s for r in reports for s in r.skipped]
     print(
-        f"[photon-lint] program checks: {report.programs_checked} "
-        f"precompiled executables audited, "
-        f"{len(report.census)} distinct solve shapes"
+        f"[photon-lint] program checks: {programs} precompiled "
+        f"executables audited ({reports[0].programs_checked} coordinate "
+        f"+ {scorer_programs} scorer), "
+        f"{len(reports[0].census)} distinct solve shapes, mesh="
+        f"{'none' if mesh is None else 'x'.join(map(str, mesh.devices.shape))}"
     )
-    for pf in report.findings:
+    print_program_table(reports)
+    for s in skipped:
+        print(
+            f"  WARNING: {s['program']} skipped — module text unreadable "
+            f"({s['reason']})"
+        )
+        jsonl_rows.append({"engine": "spmd", "kind": "skipped", **s})
+    for report in reports:
+        for row in report.comm:
+            jsonl_rows.append({"engine": "spmd", "kind": "comm-census", **row})
+    for pf in findings:
         print(f"  {pf.render()}")
         jsonl_rows.append({"engine": "hlo", **pf.to_json()})
-    if report.programs_checked == 0:
+    if scorer_error is not None:
+        print(
+            f"[photon-lint] ERROR: scorer fixture failed to build: "
+            f"{scorer_error}"
+        )
+        return 1
+    if programs == 0:
         print("[photon-lint] ERROR: precompile produced no executables")
         return 1
-    return 1 if report.findings else 0
+    if scorer_programs == 0:
+        print("[photon-lint] ERROR: scorer precompile produced no executables")
+        return 1
+    if len(skipped) >= programs:
+        # every executable's module text was unreadable: zero contract
+        # checks actually ran — that is a broken gate, not a clean one
+        print(
+            "[photon-lint] ERROR: all audited executables were skipped "
+            "(module text unreadable) — the program checks ran on nothing"
+        )
+        return 1
+    return 1 if findings else 0
 
 
-def main(argv=None) -> int:
+def main(argv: Sequence[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m photon_tpu.analysis",
         description="photon-lint: device-discipline static analysis",

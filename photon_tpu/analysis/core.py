@@ -26,7 +26,7 @@ import io
 import re
 import tokenize
 from pathlib import Path
-from typing import Callable, Iterable, Sequence
+from typing import Any, Callable, Iterable, Iterator, Sequence
 
 #: modules whose steady-state loops the perf PRs made sync-free /
 #: donation-safe — PHL001/PHL002 fire only here (relative posix paths or
@@ -38,6 +38,12 @@ HOT_PATH_FILES = (
 )
 HOT_PATH_PREFIXES = ("photon_tpu/optimize/",)
 
+#: modules where device PLACEMENT decisions live — the hot paths plus the
+#: mesh/sharding layer. PHL007 (un-sharded device_put) fires only here:
+#: a probe script committing to the default device is fine; a mesh-scoped
+#: module doing it is how an entity table lands fully replicated.
+MESH_SCOPED_PREFIXES = ("photon_tpu/parallel/",)
+
 _ANNOTATION_RE = re.compile(
     r"#\s*phl-ok:\s*(?P<rules>PHL\d{3}(?:\s*,\s*PHL\d{3})*)\s*(?P<reason>\S.*)?$"
 )
@@ -47,6 +53,13 @@ def is_hot_path(relpath: str) -> bool:
     p = relpath.replace("\\", "/")
     return p in HOT_PATH_FILES or any(
         p.startswith(pref) for pref in HOT_PATH_PREFIXES
+    )
+
+
+def is_mesh_scoped(relpath: str) -> bool:
+    p = relpath.replace("\\", "/")
+    return is_hot_path(p) or any(
+        p.startswith(pref) for pref in MESH_SCOPED_PREFIXES
     )
 
 
@@ -66,7 +79,7 @@ class Finding:
     def with_status(self, status: str) -> "Finding":
         return dataclasses.replace(self, status=status)
 
-    def to_json(self) -> dict:
+    def to_json(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
 
     def render(self) -> str:
@@ -86,6 +99,8 @@ class FileContext:
     hot: bool
     #: line → set of rule ids suppressed by a reasoned ``# phl-ok:``
     annotations: dict[int, set[str]]
+    #: hot-path OR mesh/sharding-layer module (see is_mesh_scoped)
+    mesh_scoped: bool = False
     #: node-id set shared between cooperating rules (PHL001 claims
     #: escaping np.asarray nodes so PHL002 doesn't double-report them)
     claimed: set[int] = dataclasses.field(default_factory=set)
@@ -119,7 +134,9 @@ class FileContext:
     def parent(self, node: ast.AST) -> ast.AST | None:
         return self.parents().get(id(node))
 
-    def enclosing_function(self, node: ast.AST):
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
         cur = self.parent(node)
         while cur is not None:
             if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -140,6 +157,7 @@ class Rule:
     rule_id: str = "PHL000"
     title: str = ""
     hot_path_only: bool = False
+    mesh_scoped_only: bool = False
 
     def check(self, ctx: FileContext) -> list[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -214,7 +232,7 @@ def keyword_arg(call: ast.Call, name: str) -> ast.expr | None:
 _REGISTRY: list[Rule] = []
 
 
-def register(rule_cls: type) -> type:
+def register(rule_cls: type[Rule]) -> type[Rule]:
     _REGISTRY.append(rule_cls())
     return rule_cls
 
@@ -225,6 +243,7 @@ def all_rules() -> list[Rule]:
         rules_ctypes,
         rules_host_sync,
         rules_jit,
+        rules_spmd,
         rules_threads,
     )
 
@@ -236,11 +255,14 @@ def analyze_source(
     path: str,
     *,
     hot: bool | None = None,
+    mesh_scoped: bool | None = None,
     rules: Iterable[Rule] | None = None,
 ) -> list[Finding]:
     """Run the AST rules over one file's source. Annotated findings are
     returned with status="annotated"; callers decide whether those gate.
-    ``hot=None`` classifies from the path (tests force it for fixtures)."""
+    ``hot=None`` / ``mesh_scoped=None`` classify from the path (tests
+    force them for fixtures) — the two scopes are independent: forcing
+    one must not silently decide the other."""
     relpath = path.replace("\\", "/")
     lines = src.splitlines()
     try:
@@ -262,10 +284,15 @@ def analyze_source(
         lines=lines,
         hot=is_hot_path(relpath) if hot is None else hot,
         annotations=parse_annotations(src),
+        mesh_scoped=(
+            is_mesh_scoped(relpath) if mesh_scoped is None else mesh_scoped
+        ),
     )
     findings: list[Finding] = []
     for rule in rules if rules is not None else all_rules():
         if rule.hot_path_only and not ctx.hot:
+            continue
+        if rule.mesh_scoped_only and not ctx.mesh_scoped:
             continue
         for f in rule.check(ctx):
             findings.append(

@@ -29,7 +29,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import re
-from typing import Iterable, Mapping
+from typing import Any, Mapping
 
 import numpy as np
 
@@ -77,11 +77,11 @@ class ProgramFinding:
     def render(self) -> str:
         return f"[{self.check}] {self.program}: {self.message}"
 
-    def to_json(self) -> dict:
+    def to_json(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
 
 
-def module_text(obj) -> str:
+def module_text(obj: Any) -> str:
     """Module text from a Compiled/Lowered/str."""
     if isinstance(obj, str):
         return obj
@@ -94,6 +94,18 @@ def module_text(obj) -> str:
     )
 
 
+def try_module_text(obj: Any) -> tuple[str | None, str | None]:
+    """``(text, None)`` or ``(None, reason)`` — some backends' executables
+    raise from ``as_text()`` (serialization not implemented, relay
+    transport errors). One unprintable program must degrade to a
+    skipped-with-warning audit entry, not kill the whole ``--programs``
+    run."""
+    try:
+        return module_text(obj), None
+    except Exception as e:
+        return None, f"{type(e).__name__}: {e}"
+
+
 # --- collective freedom ---------------------------------------------------
 
 
@@ -101,7 +113,7 @@ def find_collectives(text: str) -> list[str]:
     return sorted(set(_COLLECTIVE_RE.findall(text)))
 
 
-def check_no_collectives(obj, program: str) -> list[ProgramFinding]:
+def check_no_collectives(obj: Any, program: str) -> list[ProgramFinding]:
     collectives = find_collectives(module_text(obj))
     if not collectives:
         return []
@@ -122,7 +134,7 @@ def check_no_collectives(obj, program: str) -> list[ProgramFinding]:
 # --- constant embedding ---------------------------------------------------
 
 
-def collect_jaxpr_consts(closed_jaxpr, out: list) -> None:
+def collect_jaxpr_consts(closed_jaxpr: Any, out: list[Any]) -> None:
     """Consts of this jaxpr AND of every nested ClosedJaxpr: a jitted
     callee's closure constants live on the inner pjit equation's jaxpr —
     the outer ``make_jaxpr`` consts list stays empty, so a non-recursive
@@ -139,10 +151,10 @@ def collect_jaxpr_consts(closed_jaxpr, out: list) -> None:
 
 
 def check_jaxpr_const_embedding(
-    closed_jaxpr, program: str, limit: int = DEFAULT_CONST_BYTES_LIMIT
+    closed_jaxpr: Any, program: str, limit: int = DEFAULT_CONST_BYTES_LIMIT
 ) -> list[ProgramFinding]:
     """Trace-level pass (pre-lowering): closure constants by array size."""
-    consts: list = []
+    consts: list[Any] = []
     collect_jaxpr_consts(closed_jaxpr, consts)
     offenders = [
         (int(np.asarray(c).nbytes), getattr(c, "shape", None))
@@ -185,7 +197,7 @@ def find_large_constants(
 
 
 def check_const_embedding(
-    obj, program: str, limit: int = DEFAULT_CONST_BYTES_LIMIT
+    obj: Any, program: str, limit: int = DEFAULT_CONST_BYTES_LIMIT
 ) -> list[ProgramFinding]:
     offenders = find_large_constants(module_text(obj), limit)
     if not offenders:
@@ -206,7 +218,9 @@ def check_const_embedding(
 # --- solve-shape census ---------------------------------------------------
 
 
-def solve_shape_census(coordinates: Mapping) -> set[tuple[int, int]]:
+def solve_shape_census(
+    coordinates: Mapping[str, Any]
+) -> set[tuple[int, int]]:
     """Distinct (active_rows, d) solve shapes a built fit will compile,
     read off the device buckets of every random-effect coordinate —
     the same quantity the PR 3 shape budget bounds."""
@@ -220,7 +234,7 @@ def solve_shape_census(coordinates: Mapping) -> set[tuple[int, int]]:
 
 
 def check_shape_budget(
-    coordinates: Mapping, budget: int | None
+    coordinates: Mapping[str, Any], budget: int | None
 ) -> list[ProgramFinding]:
     """Census vs the PR 3 budget: the fit's TOTAL distinct solve shapes
     must not exceed it (None/0 = budget disabled, census-only)."""
@@ -249,52 +263,170 @@ class AuditReport:
     programs_checked: int
     findings: list[ProgramFinding]
     census: set[tuple[int, int]]
+    #: per-executable comm/compute rows (the census table --programs
+    #: prints): program, ledger_label, flops, collective sites, bytes
+    comm: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    #: executables whose module text was unreadable — audited checks
+    #: skipped with a warning instead of crashing the run
+    skipped: list[dict[str, Any]] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
         return not self.findings
 
 
+def _coordinate_contract(coord: Any) -> Any:
+    """The coordinate's declared SPMD contract, or an inferred fallback
+    for foreign coordinate objects: RE-like kinds (per-entity-independent
+    solves) get the zero allowance, everything else is census-only."""
+    from photon_tpu.analysis import spmd
+
+    decl = getattr(coord, "spmd_contract", None)
+    if callable(decl):
+        contract = decl()
+        if isinstance(contract, spmd.SpmdContract):
+            return contract
+    if "RandomEffect" in type(coord).__name__:
+        return spmd.SpmdContract(comm=spmd.COLLECTIVE_FREE)
+    return spmd.SpmdContract(comm=spmd.ANY_COMM)
+
+
+def _audit_one_program(
+    exe: Any,
+    label: str,
+    ledger_label: str,
+    contract: Any,
+    const_bytes_limit: int,
+    findings: list[ProgramFinding],
+    comm_rows: list[dict[str, Any]],
+    skipped: list[dict[str, Any]],
+    kind: str = "",
+) -> bool:
+    """All text+API passes over one executable. Returns False when the
+    module text was unreadable (recorded in ``skipped``)."""
+    from photon_tpu.analysis import spmd
+
+    text, err = try_module_text(exe)
+    if text is None:
+        skipped.append({"program": label, "reason": err})
+        return False
+    sites = spmd.communication_census(text)
+    findings.extend(
+        spmd.check_comm_allowance(sites, contract.comm_for(kind), label)
+    )
+    findings.extend(check_const_embedding(text, label, const_bytes_limit))
+    findings.extend(
+        spmd.check_sharding_contract(text, label, contract.sharding)
+    )
+    if contract.sharding.on_mesh and contract.sharding.partitioned_results:
+        findings.extend(spmd.check_result_partitioning(exe, label))
+    comm_rows.append(
+        {
+            "program": label,
+            "ledger_label": ledger_label,
+            "flops": spmd.executable_flops(exe),
+            "collective_sites": [s.to_json() for s in sites],
+            "comm_bytes": spmd.comm_bytes(sites),
+        }
+    )
+    return True
+
+
 def audit_coordinates(
-    coordinates: Mapping,
+    coordinates: Mapping[str, Any],
     *,
     const_bytes_limit: int = DEFAULT_CONST_BYTES_LIMIT,
     shape_budget: int | None = None,
-    collective_free: Iterable[str] | None = None,
+    contracts: Mapping[str, Any] | None = None,
 ) -> AuditReport:
     """Run every program pass over every AOT-precompiled executable of
     the given coordinates (run ``descent.precompile_coordinates`` first —
     the executables this audits are exactly the ones a fit dispatches).
 
-    Collective-freedom applies to random-effect coordinates by default
-    (their solves are per-entity independent; a sharded FE matvec may
-    legitimately reduce) — pass ``collective_free`` to name coordinates
-    explicitly. The constant-embedding bound applies to every program.
+    Each coordinate is audited against its own declared
+    :class:`photon_tpu.analysis.spmd.SpmdContract`
+    (``Coordinate.spmd_contract()``): the communication census must fit
+    the coordinate's allowance (RE: collective-free, the PAPER §L4/L5
+    per-entity-independence invariant; FE: bounded d-vector all-reduces),
+    replicated parameters must stay under the contract's byte limit (the
+    entity-table-compiled-replicated failure), meshed programs must keep
+    partitioned results, and live table placement must match. Pass
+    ``contracts`` (cid → SpmdContract) to override declarations. The
+    constant-embedding bound applies to every program; an executable
+    whose module text is unreadable is reported in ``report.skipped``
+    instead of crashing the run.
     """
+    from photon_tpu.analysis import spmd
+
     findings: list[ProgramFinding] = []
+    comm_rows: list[dict[str, Any]] = []
+    skipped: list[dict[str, Any]] = []
     programs = 0
-    # materialize once: a one-shot iterable consumed inside the loop
-    # would silently skip the collectives check from coordinate 2 on
-    cf_names = None if collective_free is None else set(collective_free)
     for cid, coord in coordinates.items():
-        re_like = (
-            cid in cf_names
-            if cf_names is not None
-            else "RandomEffect" in type(coord).__name__
+        contract = (
+            contracts[cid]
+            if contracts is not None and cid in contracts
+            else _coordinate_contract(coord)
         )
         executables = coord.aot_executables() or {}
         for key in sorted(executables, key=repr):
             label = f"{cid}:{':'.join(str(k) for k in key)}"
-            text = module_text(executables[key])
+            kind = str(key[0]) if isinstance(key, tuple) and key else label
+            ledger_label = f"{cid}:{kind}" if isinstance(key, tuple) else label
             programs += 1
-            if re_like:
-                findings.extend(check_no_collectives(text, label))
-            findings.extend(
-                check_const_embedding(text, label, const_bytes_limit)
+            _audit_one_program(
+                executables[key], label, ledger_label, contract,
+                const_bytes_limit, findings, comm_rows, skipped, kind=kind,
             )
+    findings.extend(spmd.check_table_placement(coordinates))
     findings.extend(check_shape_budget(coordinates, shape_budget))
     return AuditReport(
         programs_checked=programs,
         findings=findings,
         census=solve_shape_census(coordinates),
+        comm=comm_rows,
+        skipped=skipped,
+    )
+
+
+def audit_scorer(
+    scorer: Any,
+    *,
+    const_bytes_limit: int = DEFAULT_CONST_BYTES_LIMIT,
+    contract: Any = None,
+) -> AuditReport:
+    """The streaming scorer's analogue of :func:`audit_coordinates`:
+    every per-batch-shape executable ``GameScorer.precompile`` built
+    (``scorer.aot_executables()``) gets the same comm census, sharding
+    contract, and constant-embedding passes. The default contract is the
+    single-host one — collective-free (a fused scoring batch never talks
+    across devices) with no mesh claims; a future mesh-sharded scorer
+    passes its own."""
+    from photon_tpu.analysis import spmd
+
+    if contract is None:
+        contract = spmd.SpmdContract(
+            comm=dataclasses.replace(
+                spmd.COLLECTIVE_FREE,
+                reason="fused scoring batch: one device, zero collectives",
+            )
+        )
+    findings: list[ProgramFinding] = []
+    comm_rows: list[dict[str, Any]] = []
+    skipped: list[dict[str, Any]] = []
+    programs = 0
+    executables = scorer.aot_executables() or {}
+    for key in sorted(executables, key=repr):
+        label = f"score:{key}"
+        programs += 1
+        _audit_one_program(
+            executables[key], label, label, contract,
+            const_bytes_limit, findings, comm_rows, skipped, kind="score",
+        )
+    return AuditReport(
+        programs_checked=programs,
+        findings=findings,
+        census=set(),
+        comm=comm_rows,
+        skipped=skipped,
     )

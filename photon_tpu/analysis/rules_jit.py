@@ -23,6 +23,7 @@ Scope: functions whose decorator is visibly ``jit``/``jax.jit``/
 from __future__ import annotations
 
 import ast
+from typing import Iterator
 
 from photon_tpu.analysis.core import (
     FileContext,
@@ -148,7 +149,9 @@ class JitRetraceHazard(Rule):
             out.extend(self._check_branches(ctx, fn, traced))
         return out
 
-    def _check_defaults(self, ctx, fn: ast.FunctionDef, static: set[str]):
+    def _check_defaults(
+        self, ctx: FileContext, fn: ast.FunctionDef, static: set[str]
+    ) -> Iterator[Finding]:
         args = fn.args.posonlyargs + fn.args.args
         defaults = fn.args.defaults
         for arg, default in zip(args[len(args) - len(defaults):], defaults):
@@ -165,7 +168,9 @@ class JitRetraceHazard(Rule):
                     f"use a tuple/frozenset",
                 )
 
-    def _check_branches(self, ctx, fn: ast.FunctionDef, traced: set[str]):
+    def _check_branches(
+        self, ctx: FileContext, fn: ast.FunctionDef, traced: set[str]
+    ) -> Iterator[Finding]:
         # nested function defs introduce new scopes; keep it simple and
         # only scan statements belonging to fn itself
         for node in ast.walk(fn):

@@ -1,0 +1,102 @@
+"""PHL007/PHL008 — SPMD placement and shard_map contract discipline.
+
+PHL007 is the silently-replicated-table class the PR 9 program auditor
+(analysis/spmd.py) pins at the compiled level, caught here at the source
+level: ``jax.device_put(x)`` with no sharding/device commits the array to
+the default device — numerically invisible, and under a mesh it either
+replicates the block per device (the O(devices) memory failure that
+kills the hundreds-of-billions-of-coefficients capacity claim) or forces
+GSPMD to reshard it at every dispatch. Every intentional placement in
+mesh-scoped modules names its layout (``NamedSharding``/device); the one
+deliberate default-device put (the single-host scorer's batch staging)
+carries its annotation.
+
+PHL008 is the shard_map half of the same contract: an ``out_specs``-less
+``shard_map`` call leaves the output layout to whatever the refactor du
+jour infers — and inside ``shard_map_unchecked`` regions the replication
+checker is DISABLED (that is the wrapper's entire point), so nothing
+stops a per-entity-sharded result from silently flipping to replicated.
+DrJAX (PAPERS.md) makes the case that MapReduce-style JAX programs need
+these contracts stated and checked mechanically; the auditor checks the
+compiled artifact, this rule keeps the declaration at every call site.
+"""
+from __future__ import annotations
+
+import ast
+
+from photon_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    register,
+)
+
+_DEVICE_PUT_NAMES = {"jax.device_put", "device_put"}
+_SHARD_MAP_NAMES = {"shard_map", "shard_map_unchecked"}
+
+
+@register
+class DevicePutWithoutSharding(Rule):
+    rule_id = "PHL007"
+    title = "device_put without an explicit sharding in mesh-scoped code"
+    mesh_scoped_only = True
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _DEVICE_PUT_NAMES:
+                continue
+            has_target = len(node.args) >= 2 or any(
+                kw.arg in ("device", "sharding") for kw in node.keywords
+            )
+            if not has_target:
+                out.append(
+                    ctx.finding(
+                        self.rule_id,
+                        node,
+                        "jax.device_put without an explicit sharding "
+                        "commits to the default device — under a mesh "
+                        "this is how an entity-sharded table lands fully "
+                        "replicated (or pays a reshard every dispatch); "
+                        "pass a NamedSharding/device, or annotate the "
+                        "deliberate single-host placement",
+                    )
+                )
+        return out
+
+
+@register
+class ShardMapWithoutOutSpecs(Rule):
+    rule_id = "PHL008"
+    title = "shard_map call site without explicit out_specs"
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None or name.split(".")[-1] not in _SHARD_MAP_NAMES:
+                continue
+            # positional form: shard_map(f, mesh, in_specs, out_specs)
+            has_out = len(node.args) >= 4 or any(
+                kw.arg == "out_specs" for kw in node.keywords
+            )
+            if not has_out:
+                out.append(
+                    ctx.finding(
+                        self.rule_id,
+                        node,
+                        "shard_map without explicit out_specs leaves the "
+                        "output layout to inference — and inside "
+                        "shard_map_unchecked regions the replication "
+                        "checker is OFF, so a sharded result can flip to "
+                        "replicated silently; declare out_specs at every "
+                        "call site (the SPMD auditor checks the compiled "
+                        "artifact, this keeps the contract in the source)",
+                    )
+                )
+        return out

@@ -20,6 +20,7 @@ carry an annotation.
 from __future__ import annotations
 
 import ast
+from typing import Iterator
 
 from photon_tpu.analysis.core import (
     FileContext,
@@ -34,13 +35,13 @@ _THREAD_CALLS = {"threading.Thread", "Thread"}
 _QUEUE_CALLS = {"queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue"}
 
 
-def _finally_blocks(fn: ast.AST):
+def _finally_blocks(fn: ast.AST) -> Iterator[list[ast.stmt]]:
     for node in ast.walk(fn):
         if isinstance(node, ast.Try) and node.finalbody:
             yield node.finalbody
 
 
-def _contains_join(stmts) -> bool:
+def _contains_join(stmts: list[ast.stmt]) -> bool:
     """A thread-reap shaped join: ``t.join()`` / ``t.join(timeout=5)``.
     ``str.join`` always takes exactly one positional argument (the
     iterable), so requiring zero positional args keeps a ``",".join(xs)``
@@ -89,7 +90,9 @@ class ThreadLifecycle(Rule):
                 out.extend(self._check_put(ctx, node))
         return out
 
-    def _check_thread(self, ctx: FileContext, node: ast.Call):
+    def _check_thread(
+        self, ctx: FileContext, node: ast.Call
+    ) -> Iterator[Finding]:
         fn = ctx.enclosing_function(node)
         if fn is None:
             yield ctx.finding(
@@ -113,7 +116,9 @@ class ThreadLifecycle(Rule):
                 f"try/finally: stop.set(); drain; t.join()",
             )
 
-    def _check_queue(self, ctx: FileContext, node: ast.Call, name: str):
+    def _check_queue(
+        self, ctx: FileContext, node: ast.Call, name: str
+    ) -> Iterator[Finding]:
         if "SimpleQueue" in name:
             yield ctx.finding(
                 self.rule_id,
@@ -138,7 +143,9 @@ class ThreadLifecycle(Rule):
                 "bound is the contract)",
             )
 
-    def _check_put(self, ctx: FileContext, node: ast.Call):
+    def _check_put(
+        self, ctx: FileContext, node: ast.Call
+    ) -> Iterator[Finding]:
         if not (
             isinstance(node.func, ast.Attribute) and node.func.attr == "put"
         ):

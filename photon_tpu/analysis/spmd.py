@@ -1,0 +1,621 @@
+"""SPMD program auditor: communication census + sharding contracts.
+
+ROADMAP item 1 (mesh-sharded GAME training) lives or dies on two
+quantities the PR 6 passes could not see:
+
+* **Communication volume.** "Large Scale Distributed Linear Algebra With
+  TPUs" (PAPERS.md) shows the distributed win is decided by bytes moved
+  per step, and PR 6's collective check was a boolean — a program either
+  contained a collective or it didn't. The census here parses every
+  collective site out of HLO/StableHLO module text WITH its payload
+  shape, dtype, byte size, and replica groups, so a program's
+  communication is priced, not just detected, and each coordinate can
+  carry a per-program *allowance* (the FE solve may all-reduce one
+  d-vector per iteration; the RE solves must stay collective-free — the
+  PAPER §L4/L5 per-entity-independence invariant).
+* **Sharding contracts.** DrJAX (PAPERS.md) argues MapReduce-style JAX
+  programs need their sharding contracts checked mechanically. The
+  classic silent failure is an entity-sharded table compiled as fully
+  replicated: numerics identical, memory O(devices) worse, and the
+  hundreds-of-billions-of-coefficients capacity claim quietly gone. The
+  contract checks read the compiled module's own per-parameter sharding
+  annotations (``sharding={devices=[8,1]<=[8]}`` / ``{replicated}`` —
+  pruning-proof, unlike zipping ``Compiled.input_shardings`` against a
+  call template, which ``keep_unused=False`` misaligns) plus the
+  executable's result shardings, and fail on oversized replicated
+  operands and on programs that lost their partitioning entirely.
+
+Everything here is text/metadata analysis — stdlib + numpy at module
+scope; jax is imported lazily inside the few checks that read live
+arrays or ``Compiled`` attributes, so the AST gate stays import-light.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Iterable, Mapping
+
+from photon_tpu.analysis.hlo import ProgramFinding, _dtype_bytes
+
+__all__ = [
+    "ANY_COMM",
+    "COLLECTIVE_FREE",
+    "CollectiveSite",
+    "CommAllowance",
+    "ParamSharding",
+    "ShardingContract",
+    "SpmdContract",
+    "check_comm_allowance",
+    "check_jaxpr_no_collectives",
+    "check_result_partitioning",
+    "check_sharding_contract",
+    "check_table_placement",
+    "communication_census",
+    "executable_flops",
+    "find_jaxpr_collectives",
+    "parse_param_shardings",
+]
+
+# --- contracts ------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CommAllowance:
+    """What a program is ALLOWED to say over the interconnect.
+
+    ``ops`` are collective families (HLO spelling, e.g. ``"all-reduce"``;
+    ``"*"`` admits any family). ``max_bytes_per_site`` bounds the payload
+    of each collective SITE in the module text (a site inside a while
+    body executes once per iteration — the census counts program text,
+    so the bound is per-dispatch-per-iteration); ``None`` means
+    unbounded. The default is the zero allowance: no collectives at all.
+    """
+
+    ops: tuple[str, ...] = ()
+    max_bytes_per_site: int | None = 0
+    reason: str = ""
+
+    def admits_op(self, op: str) -> bool:
+        family = _collective_family(op)
+        return "*" in self.ops or family in self.ops
+
+
+#: the RE-solve contract: per-entity independence means NOTHING crosses
+#: devices (PAPER §L4/L5; PERF.md r5 — overhead on ICI, fatal straggle
+#: on the virtual mesh)
+COLLECTIVE_FREE = CommAllowance(
+    ops=(), max_bytes_per_site=0,
+    reason="per-shard-independent program: zero collectives",
+)
+
+#: no declared contract — census is reported but nothing gates
+ANY_COMM = CommAllowance(
+    ops=("*",), max_bytes_per_site=None, reason="no declared allowance"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingContract:
+    """Declared partitioning contract for a coordinate's programs.
+
+    ``on_mesh=False`` (single-device programs) disables every check.
+    ``replicated_bytes_limit`` is the largest parameter that may
+    legitimately be fully replicated (λ scalars, an FE d-vector state);
+    a bigger replicated parameter is the entity-table-compiled-
+    replicated failure. ``partitioned_params``/``partitioned_results``
+    assert the program kept ANY partitioning at all — a module whose
+    every parameter/result is replicated has silently fallen off the
+    mesh.
+    """
+
+    on_mesh: bool = False
+    replicated_bytes_limit: int = 0
+    partitioned_params: bool = False
+    partitioned_results: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class SpmdContract:
+    """One coordinate's declared SPMD contract.
+
+    ``comm`` is the default allowance; ``comm_overrides`` refines it per
+    program KIND (the first element of the executable cache key —
+    ``"sweep"``, ``"score"``), because one coordinate's programs can have
+    different legitimate communication: the RE *solve* is collective-free
+    by construction (PAPER §L4/L5, pinned at the train program), while
+    its fused sweep/score programs fold per-entity scores back into
+    row-sharded totals — bounded gathers/reduces, not zero.
+    """
+
+    comm: CommAllowance = COLLECTIVE_FREE
+    sharding: ShardingContract = ShardingContract()
+    comm_overrides: Mapping[str, CommAllowance] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def comm_for(self, kind: str) -> CommAllowance:
+        return self.comm_overrides.get(kind, self.comm)
+
+
+# --- communication census -------------------------------------------------
+
+#: collective families, HLO spelling (the StableHLO spellings normalize
+#: onto these)
+_FAMILIES = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "reduce-scatter",
+    "collective-permute",
+    "collective-broadcast",
+)
+
+# `%x = f32[16,4]{1,0} all-gather(f32[2,4]{1,0} %p), ..., replica_groups=...`
+_HLO_COLL_RE = re.compile(
+    r"=\s*(?P<result>[^=\n]*?)\s*"
+    r"(?P<op>all-reduce|all-gather|all-to-all|reduce-scatter"
+    r"|collective-permute|collective-broadcast)"
+    r"(?P<async>-start|-done)?\("
+)
+# `"stablehlo.all_gather"(%1) ... : (tensor<2x4xf32>) -> tensor<16x4xf32>`
+_SHLO_COLL_RE = re.compile(
+    r"stablehlo\.(?P<op>all_reduce|all_gather|all_to_all|reduce_scatter"
+    r"|collective_permute|collective_broadcast)\"?\("
+)
+_HLO_SHAPE_RE = re.compile(
+    r"\b(?P<dtype>pred|bf16|c64|c128|[fsu]\d+)\[(?P<dims>[0-9,]*)\]"
+)
+_SHLO_TENSOR_RE = re.compile(
+    r"tensor<(?P<sig>(?:[0-9]+x)*"
+    r"(?P<dtype>pred|[fsu]\d+|bf16|i\d+|ui\d+))>"
+)
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(?P<g>\[[^\]]*\]<=\[\d+\]|\{[^{}]*(?:\{[^{}]*\})*[^{}]*\})"
+)
+_SHLO_GROUPS_RE = re.compile(r"replica_groups\s*=\s*(?P<g>dense<[^>]*>)")
+
+
+def _collective_family(op: str) -> str:
+    base = op.replace("_", "-")
+    for fam in _FAMILIES:
+        if base.startswith(fam):
+            return fam
+    return base
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSite:
+    """One collective op site in a module's text."""
+
+    op: str  # normalized family, e.g. "all-reduce"
+    shape: str  # textual payload signature, e.g. "f32[16,4]"
+    nbytes: int | None  # payload bytes (None when unparsable)
+    replica_groups: str
+    line: int  # 1-based line in the module text
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _hlo_result_bytes(
+    result: str, dedup: bool = False
+) -> tuple[str, int | None]:
+    """(signature, bytes) of an HLO result type string (tuples summed).
+
+    ``dedup`` counts each distinct shape ONCE — async ``-start`` results
+    are tuples carrying BOTH the aliased operand and the result buffer
+    (``(f32[1024], f32[1024])``), so a plain sum would price the payload
+    twice and falsely breach a tight per-site allowance; variadic
+    collectives over distinct tensors still sum correctly."""
+    total = 0
+    sigs: list[str] = []
+    seen: set[str] = set()
+    for m in _HLO_SHAPE_RE.finditer(result):
+        sig = f"{m.group('dtype')}[{m.group('dims')}]"
+        if dedup:
+            if sig in seen:
+                continue
+            seen.add(sig)
+        dims = [int(d) for d in m.group("dims").split(",") if d]
+        total += math.prod(dims) * _dtype_bytes(m.group("dtype"))
+        sigs.append(sig)
+    if not sigs:
+        return result.strip() or "?", None
+    return ", ".join(sigs), total
+
+
+def _shlo_result_bytes(text: str, start: int) -> tuple[str, int | None]:
+    """Payload of a StableHLO collective whose result type is on the SAME
+    line (the quoted no-region forms). Regioned ops (``all_reduce`` with
+    a reducer block) put the type lines away — those sites report
+    ``nbytes=None`` and the allowance check treats an unknown payload as
+    exceeding any finite bound (fail loud, not open)."""
+    eol = text.find("\n", start)
+    line = text[start : eol if eol >= 0 else len(text)]
+    arrow = line.rfind("->")
+    if arrow < 0:
+        return "?", None
+    total = 0
+    sigs = []
+    for m in _SHLO_TENSOR_RE.finditer(line[arrow:]):
+        sig = m.group("sig")
+        dims = [int(d) for d in sig.split("x")[:-1] if d.isdigit()]
+        total += math.prod(dims) * _dtype_bytes(m.group("dtype"))
+        sigs.append(f"tensor<{sig}>")
+    if not sigs:
+        return "?", None
+    return ", ".join(sigs), total
+
+
+def communication_census(text: str) -> list[CollectiveSite]:
+    """Every collective site in HLO or StableHLO module text, with its
+    payload priced. Async HLO pairs count once (``-start`` carries the
+    payload; ``-done`` is skipped)."""
+    sites: list[CollectiveSite] = []
+    for m in _HLO_COLL_RE.finditer(text):
+        if m.group("async") == "-done":
+            continue
+        sig, nbytes = _hlo_result_bytes(
+            m.group("result"), dedup=m.group("async") == "-start"
+        )
+        groups = _REPLICA_GROUPS_RE.search(
+            text, m.end(), text.find("\n", m.end()) % (len(text) + 1)
+        )
+        sites.append(
+            CollectiveSite(
+                op=_collective_family(m.group("op")),
+                shape=sig,
+                nbytes=nbytes,
+                replica_groups=groups.group("g") if groups else "",
+                line=text.count("\n", 0, m.start()) + 1,
+            )
+        )
+    for m in _SHLO_COLL_RE.finditer(text):
+        sig, nbytes = _shlo_result_bytes(text, m.start())
+        eol = text.find("\n", m.end())
+        groups = _SHLO_GROUPS_RE.search(
+            text, m.end(), eol if eol >= 0 else len(text)
+        )
+        sites.append(
+            CollectiveSite(
+                op=_collective_family(m.group("op")),
+                shape=sig,
+                nbytes=nbytes,
+                replica_groups=groups.group("g") if groups else "",
+                line=text.count("\n", 0, m.start()) + 1,
+            )
+        )
+    return sites
+
+
+def comm_bytes(sites: Iterable[CollectiveSite]) -> int:
+    """Σ known payload bytes over the census (one execution per site)."""
+    return sum(s.nbytes or 0 for s in sites)
+
+
+def check_comm_allowance(
+    sites: Iterable[CollectiveSite],
+    allowance: CommAllowance,
+    program: str,
+) -> list[ProgramFinding]:
+    """Every site must be of an allowed family AND within the per-site
+    payload bound. An unparsable payload fails any finite bound — the
+    check must not be open on what it cannot price."""
+    findings: list[ProgramFinding] = []
+    for s in sites:
+        if not allowance.admits_op(s.op):
+            findings.append(
+                ProgramFinding(
+                    check="comm-allowance",
+                    program=program,
+                    message=(
+                        f"collective {s.op} of {s.shape} "
+                        f"({s.nbytes if s.nbytes is not None else '?'} B, "
+                        f"replica_groups {s.replica_groups or '?'}, module "
+                        f"line {s.line}) is not in this program's "
+                        f"allowance {allowance.ops or '()'} — "
+                        f"{allowance.reason or 'no collectives declared'}"
+                    ),
+                )
+            )
+        elif allowance.max_bytes_per_site is not None and (
+            s.nbytes is None or s.nbytes > allowance.max_bytes_per_site
+        ):
+            findings.append(
+                ProgramFinding(
+                    check="comm-allowance",
+                    program=program,
+                    message=(
+                        f"collective {s.op} moves {s.shape} "
+                        f"({s.nbytes if s.nbytes is not None else 'unpriceable'} B "
+                        f"per execution, module line {s.line}) — over this "
+                        f"program's {allowance.max_bytes_per_site} B/site "
+                        f"allowance ({allowance.reason})"
+                    ),
+                )
+            )
+    return findings
+
+
+# --- jaxpr-level collectives ----------------------------------------------
+
+_JAXPR_COLLECTIVE_PRIMS = (
+    "psum",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "all_to_all",
+    "reduce_scatter",
+    "ppermute",
+    "pbroadcast",
+)
+
+
+def find_jaxpr_collectives(closed_jaxpr: Any) -> list[str]:
+    """Collective primitive names anywhere in a (nested) ClosedJaxpr —
+    the trace-level end of the same pin the census applies at the
+    lowered and compiled levels. Only EXPLICIT collectives exist at this
+    level (GSPMD inserts its own later), so a hit here is always
+    programmer-written communication."""
+    seen: set[str] = set()
+
+    def walk(obj: Any) -> None:
+        # normalize: a ClosedJaxpr wraps .jaxpr; shard_map/pjit params
+        # can carry a PLAIN Jaxpr (no .consts) — both expose .eqns
+        jaxpr = getattr(obj, "jaxpr", obj)
+        for eqn in getattr(jaxpr, "eqns", []):
+            name = eqn.primitive.name
+            if any(name.startswith(p) for p in _JAXPR_COLLECTIVE_PRIMS):
+                seen.add(name)
+            for v in eqn.params.values():
+                if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for item in v:
+                        if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                            walk(item)
+
+    walk(closed_jaxpr)
+    return sorted(seen)
+
+
+def check_jaxpr_no_collectives(
+    closed_jaxpr: Any, program: str
+) -> list[ProgramFinding]:
+    prims = find_jaxpr_collectives(closed_jaxpr)
+    if not prims:
+        return []
+    return [
+        ProgramFinding(
+            check="comm-allowance",
+            program=program,
+            message=(
+                f"traced program carries explicit collective primitives "
+                f"{prims} — the per-shard-independent contract is broken "
+                f"before the compiler even sees it"
+            ),
+        )
+    ]
+
+
+# --- per-parameter shardings ----------------------------------------------
+
+# `%param.1 = f32[2,4]{1,0} parameter(0), sharding={devices=[8,1]<=[8]}`
+_HLO_PARAM_RE = re.compile(
+    r"=\s*(?P<type>[^=\n]*?)\s*parameter\((?P<index>\d+)\)\s*,"
+    r"[^\n]*?sharding=(?P<sh>\{[^}\n]*\})"
+)
+# `%arg0: tensor<16x4xf32> {mhlo.sharding = "{devices=[8,1]<=[8]}"}`
+_SHLO_PARAM_RE = re.compile(
+    r"%arg(?P<index>\d+):\s*tensor<(?P<sig>[^>]*)>\s*"
+    r"\{[^}]*mhlo\.sharding\s*=\s*\"(?P<sh>[^\"]*)\""
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSharding:
+    """One annotated entry parameter of an SPMD-partitioned module."""
+
+    index: int
+    signature: str
+    #: for replicated params, local == global; None when the type string
+    #: is unpriceable — the contract check FAILS CLOSED on None, same
+    #: rule as an unpriceable collective payload
+    nbytes: int | None
+    annotation: str  # raw sharding text
+    replicated: bool  # fully replicated OR maximal (single-device)
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _is_replicated_annotation(sh: str) -> bool:
+    return "replicated" in sh and "last_tile_dim" not in sh or "maximal" in sh
+
+
+def parse_param_shardings(text: str) -> list[ParamSharding]:
+    """Sharding-annotated entry parameters of an HLO or StableHLO module.
+
+    Only parameters that CARRY an annotation are returned: an SPMD-
+    partitioned module annotates every entry parameter (so the list is
+    complete for on-mesh programs), while a single-device module
+    annotates none (and has nothing to check). Replicated parameters
+    keep their global byte size in the text — exactly the quantity the
+    replicated-table check bounds."""
+    out: list[ParamSharding] = []
+    for m in _HLO_PARAM_RE.finditer(text):
+        sig, nbytes = _hlo_result_bytes(m.group("type"))
+        out.append(
+            ParamSharding(
+                index=int(m.group("index")),
+                signature=sig,
+                nbytes=nbytes,
+                annotation=m.group("sh"),
+                replicated=_is_replicated_annotation(m.group("sh")),
+            )
+        )
+    for m in _SHLO_PARAM_RE.finditer(text):
+        tm = _SHLO_TENSOR_RE.match(f"tensor<{m.group('sig')}>")
+        nbytes: int | None
+        if tm is None:
+            sig, nbytes = m.group("sig"), None
+        else:
+            sig = f"tensor<{tm.group('sig')}>"
+            dims = [
+                int(d) for d in tm.group("sig").split("x")[:-1] if d.isdigit()
+            ]
+            nbytes = math.prod(dims) * _dtype_bytes(tm.group("dtype"))
+        out.append(
+            ParamSharding(
+                index=int(m.group("index")),
+                signature=sig,
+                nbytes=nbytes,
+                annotation=m.group("sh"),
+                replicated=_is_replicated_annotation(m.group("sh")),
+            )
+        )
+    return out
+
+
+def check_sharding_contract(
+    text: str, program: str, contract: ShardingContract
+) -> list[ProgramFinding]:
+    """Module-text half of the sharding contract: no oversized replicated
+    parameter (the entity-table-compiled-replicated failure), and the
+    program must keep at least one partitioned parameter when the
+    contract says it lives on a mesh."""
+    if not contract.on_mesh:
+        return []
+    findings: list[ProgramFinding] = []
+    params = parse_param_shardings(text)
+    for p in params:
+        # an unpriceable replicated parameter (nbytes None) fails any
+        # finite limit — same fail-closed rule as the comm allowance
+        if p.replicated and (
+            p.nbytes is None or p.nbytes > contract.replicated_bytes_limit
+        ):
+            findings.append(
+                ProgramFinding(
+                    check="sharding-contract",
+                    program=program,
+                    message=(
+                        f"parameter {p.index} ({p.signature}, "
+                        f"{p.nbytes if p.nbytes is not None else 'unpriceable'}"
+                        f" B) compiled with sharding {p.annotation} — an "
+                        f"operand this size must be partitioned, not "
+                        f"replicated per device (limit "
+                        f"{contract.replicated_bytes_limit} B; the "
+                        f"silently-replicated-table failure DrJAX-style "
+                        f"contract checking exists for)"
+                    ),
+                )
+            )
+    if contract.partitioned_params and params and all(
+        p.replicated for p in params
+    ):
+        findings.append(
+            ProgramFinding(
+                check="sharding-contract",
+                program=program,
+                message=(
+                    f"every one of the module's {len(params)} annotated "
+                    f"parameters is replicated — the program fell off the "
+                    f"mesh entirely (expected at least one partitioned "
+                    f"operand)"
+                ),
+            )
+        )
+    return findings
+
+
+def check_result_partitioning(
+    compiled: Any, program: str
+) -> list[ProgramFinding]:
+    """Executable-API half of the contract: at least one RESULT leaf must
+    stay partitioned (output shardings are never pruned, unlike input
+    shardings under ``keep_unused=False``). A fit whose sweep program
+    returns everything replicated re-materializes the full state on every
+    device each step."""
+    import jax
+
+    try:
+        shardings = jax.tree_util.tree_leaves(compiled.output_shardings)
+    except Exception as e:  # non-Compiled or exotic backend
+        del e
+        return []
+    if not shardings:
+        return []
+    try:
+        if any(not s.is_fully_replicated for s in shardings):
+            return []
+    except Exception:
+        return []
+    return [
+        ProgramFinding(
+            check="sharding-contract",
+            program=program,
+            message=(
+                f"all {len(shardings)} result leaves are fully replicated "
+                f"— the program's outputs (state tables, scores) lost "
+                f"their partitioning"
+            ),
+        )
+    ]
+
+
+def check_table_placement(
+    coordinates: Mapping[str, Any]
+) -> list[ProgramFinding]:
+    """Placement-level contract: the LIVE device blocks of every meshed
+    random-effect coordinate must actually be partitioned. The compiled
+    checks bound what programs declare; this bounds what is resident —
+    together they close the implicit-resharding gap (a table placed one
+    way while the program declares another forces a reshard at every
+    dispatch)."""
+    findings: list[ProgramFinding] = []
+    for cid, coord in coordinates.items():
+        if getattr(coord, "mesh", None) is None:
+            continue
+        for i, db in enumerate(getattr(coord, "device_buckets", None) or []):
+            feats = getattr(db, "features", None)
+            sharding = getattr(feats, "sharding", None)
+            if sharding is None:
+                continue
+            try:
+                replicated = bool(sharding.is_fully_replicated)
+            except Exception:
+                continue
+            if replicated:
+                findings.append(
+                    ProgramFinding(
+                        check="sharding-contract",
+                        program=f"{cid}:bucket{i}",
+                        message=(
+                            f"entity block features{tuple(feats.shape)} is "
+                            f"resident FULLY REPLICATED on a "
+                            f"{coord.mesh.size}-device mesh — the "
+                            f"entity-sharded table contract is broken at "
+                            f"placement (O(devices) memory for nothing)"
+                        ),
+                    )
+                )
+    return findings
+
+
+# --- compute pricing ------------------------------------------------------
+
+
+def executable_flops(compiled: Any) -> float | None:
+    """XLA's own flop estimate for a compiled executable (the census
+    table's compute column); None when the backend doesn't report one."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None
+    v = ca.get("flops")
+    return float(v) if v is not None else None

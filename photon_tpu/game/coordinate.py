@@ -246,6 +246,40 @@ class Coordinate:
     #: mesh-free coordinate kinds (MF) working without a field
     mesh = None
 
+    def _reg_scalar(self, value):
+        """λ as a device scalar, CACHED per value: the steady-state sweep
+        must not pay (or, under ``PHOTON_SANITIZE=transfers``, trip on) a
+        fresh implicit host→device transfer of the same Python float
+        every step. λ-grid reweights change the value and simply miss
+        the one-entry cache; the array stays uncommitted (plain
+        ``jnp.asarray``) so both the AOT executables and the jit path
+        accept it unchanged."""
+        cached = getattr(self, "_reg_scalar_cache", None)
+        # phl-ok: PHL002 λ is a host config float (the cache key), never a device value
+        v = float(value)
+        if cached is not None and cached[0] == v:
+            return cached[1]
+        from photon_tpu.util.sanitize import sanctioned_transfers
+
+        with sanctioned_transfers(
+            "per-λ scalar placement — once per reweight, cached for the "
+            "steady state"
+        ):
+            dev = jnp.asarray(value, self.dtype)
+        self._reg_scalar_cache = (v, dev)
+        return dev
+
+    def spmd_contract(self):
+        """Declared SPMD contract (photon_tpu/analysis/spmd.py) for this
+        coordinate's hot-path programs — what the program auditor holds
+        every AOT executable to. The base default is the strictest one:
+        single-device, collective-free, no sharding claims. Mesh-aware
+        subclasses declare their allowances (FE: bounded d-vector
+        all-reduces; RE: collective-free WITH entity-sharded tables)."""
+        from photon_tpu.analysis import spmd
+
+        return spmd.SpmdContract()
+
     def to_model(self, state):
         raise NotImplementedError
 
@@ -388,7 +422,16 @@ class FixedEffectCoordinate(Coordinate):
         return self
 
     def initial_state(self) -> Array:
-        return jnp.zeros((self.num_features,), dtype=self.dtype)
+        z = jnp.zeros((self.num_features,), dtype=self.dtype)
+        if self.mesh is None:
+            return z
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # place replicated ON THE MESH (the layout _state_sds declares):
+        # a single-device zeros state would be implicitly resharded at
+        # the first sweep dispatch (a transfer the sanitizer flags) and
+        # would reject the AOT sweep executable's input shardings
+        return jax.device_put(z, NamedSharding(self.mesh, P()))
 
     def _norm_args(self) -> tuple:
         """Normalization factors/shifts as TRACED jit arguments. Reading
@@ -445,7 +488,7 @@ class FixedEffectCoordinate(Coordinate):
             self._norm_args(),
             residual_scores,
             state,
-            jnp.asarray(self.problem.config.regularization_weight, self.dtype),
+            self._reg_scalar(self.problem.config.regularization_weight),
         )
         return res.x, res
 
@@ -531,13 +574,46 @@ class FixedEffectCoordinate(Coordinate):
             total,
             score,
             state,
-            jnp.asarray(self.problem.config.regularization_weight, self.dtype),
+            self._reg_scalar(self.problem.config.regularization_weight),
         )
         d = bool(donate) if donate is not None else sweep_donation_enabled()
         out = self._aot_call(("sweep", d), *args)
         if out is not None:
             return out
         return self._active_sweep_jit(d)(self, *args)
+
+    def spmd_contract(self):
+        """Fixed-effect programs on a mesh MAY reduce — the sharded
+        matvec/solve psums ONE d-vector gradient (plus scalar loss /
+        convergence reductions) per L-BFGS iteration, the distributed-
+        matvec pattern of "Large Scale Distributed Linear Algebra With
+        TPUs" (PAPERS.md). The allowance prices exactly that; anything
+        bigger (an accidental per-row gather-back, a replicated batch) is
+        a regression. Off-mesh programs stay collective-free."""
+        from photon_tpu.analysis import spmd
+
+        if self.mesh is None:
+            return spmd.SpmdContract()
+        itemsize = int(jnp.dtype(self.dtype).itemsize)
+        d_vec = (self.num_features + 16) * itemsize
+        return spmd.SpmdContract(
+            comm=spmd.CommAllowance(
+                ops=("all-reduce",),
+                max_bytes_per_site=d_vec,
+                reason=(
+                    "FE sharded solve: one d-vector gradient reduce "
+                    "(+ scalar loss/convergence reduces) per iteration"
+                ),
+            ),
+            sharding=spmd.ShardingContract(
+                on_mesh=True,
+                # legitimately replicated: the [D] coefficient state and
+                # normalization vectors; the [N,*] batch must not be
+                replicated_bytes_limit=2 * d_vec,
+                partitioned_params=True,
+                partitioned_results=True,
+            ),
+        )
 
     def to_model(self, state: Array) -> FixedEffectModel:
         w = self.normalization.model_to_original_space(state)
@@ -728,9 +804,23 @@ class RandomEffectCoordinate(Coordinate):
         return self
 
     def initial_state(self) -> list[Array]:
+        put = lambda z: z  # noqa: E731
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from photon_tpu.parallel.mesh import ENTITY_AXIS
+
+            # entity-sharded like the live buckets and the state sds —
+            # single-device zeros would be implicitly resharded at the
+            # first sweep dispatch and reject the AOT executable
+            sh = NamedSharding(self.mesh, P(ENTITY_AXIS, None))
+            put = lambda z: jax.device_put(z, sh)  # noqa: E731
         return [
-            jnp.zeros(
-                (b.features.shape[0], b.features.shape[2]), dtype=self.dtype
+            put(
+                jnp.zeros(
+                    (b.features.shape[0], b.features.shape[2]),
+                    dtype=self.dtype,
+                )
             )
             for b in self.device_buckets
         ]
@@ -854,9 +944,7 @@ class RandomEffectCoordinate(Coordinate):
 
     def train(self, residual_scores: Array, state: list[Array]):
         dispatch_count.record(1)
-        reg_w = jnp.asarray(
-            self.problem_config.regularization_weight, self.dtype
-        )
+        reg_w = self._reg_scalar(self.problem_config.regularization_weight)
         return self._train_all_jit(
             self._train_args(), residual_scores, state, reg_w
         )
@@ -903,10 +991,17 @@ class RandomEffectCoordinate(Coordinate):
     @partial(jax.jit, static_argnums=(0, 3))
     def _score_all_jit(self, score_args, state, pad_slots) -> Array:
         TRACE_COUNTERS["re_score_all"] += 1
+        from photon_tpu.parallel.mesh import constrain_rows
+
         total = jnp.zeros((self.num_samples,), dtype=self.dtype)
         for (sf, ss, sp), coefs, pad in zip(score_args, state, pad_slots):
             total = total + self._score_bucket_body(sf, ss, sp, coefs, pad)
-        return total
+        # pin the [N] result to the row sharding: left to GSPMD the
+        # scatter-built total compiles REPLICATED (every device holds the
+        # full [N] — the SPMD auditor's partitioned-results check caught
+        # exactly this), which at north-star N is an O(N) per-device
+        # footprint for a vector the mesh should split
+        return constrain_rows(total, self.mesh)
 
     def score(self, state: list[Array]) -> Array:
         dispatch_count.record(1)
@@ -929,7 +1024,9 @@ class RandomEffectCoordinate(Coordinate):
         sweep_donation_enabled). The residual's zero-sentinel pad is built
         once, not per bucket."""
         TRACE_COUNTERS["re_sweep"] += 1
-        residual = total - score
+        from photon_tpu.parallel.mesh import constrain_rows
+
+        residual = constrain_rows(total - score, self.mesh)
         res_pad = jnp.concatenate([residual, jnp.zeros((1,), residual.dtype)])
         infos = [
             self._solve_bucket(f, l, o, tw, sp, w0, res_pad, reg_weight)
@@ -941,7 +1038,10 @@ class RandomEffectCoordinate(Coordinate):
             new_score = new_score + self._score_bucket_body(
                 sf, ss, sp, coefs, pad
             )
-        new_total = residual + new_score
+        # same row-sharding pin as _score_all_jit: GSPMD otherwise
+        # replicates the scatter-built [N] outputs across the mesh
+        new_score = constrain_rows(new_score, self.mesh)
+        new_total = constrain_rows(residual + new_score, self.mesh)
         # health fold only off-mesh: reducing entity-SHARDED per-bucket
         # values/gradients to replicated scalars would put an all-reduce
         # into the RE sweep program, breaking the no-collectives contract
@@ -1001,12 +1101,67 @@ class RandomEffectCoordinate(Coordinate):
             self._pad_slots(),
         )
 
+    def spmd_contract(self):
+        """The random-effect SOLVES are collective-free BY CONSTRUCTION —
+        per-entity solves share nothing (PAPER §L4/L5; photon-ml's whole
+        design), so any collective inside the train program is pure
+        overhead on ICI and fatal straggle on the virtual CPU mesh
+        (PERF.md r5; pinned at jaxpr/lowered/compiled level on the train
+        program). The fused sweep/score programs additionally FOLD the
+        per-entity scores into the row-sharded total — bounded, not
+        zero, communication: gathers of one bucket's table/positions and
+        reduces of one [n]-row vector per site. The allowance prices
+        exactly those; an accidental gather of the whole dataset or an
+        unbounded all-to-all fails. On a mesh the entity tables must
+        also STAY entity-sharded: a table compiled or placed fully
+        replicated keeps the numerics and silently spends O(devices)
+        memory — the failure that kills the hundreds-of-billions-of-
+        coefficients capacity claim."""
+        from photon_tpu.analysis import spmd
+
+        if self.mesh is None:
+            return spmd.SpmdContract()
+        itemsize = max(int(jnp.dtype(self.dtype).itemsize), 4)
+        rows = self.num_samples + self.mesh.size + 64
+        per_bucket = max(
+            (
+                max(
+                    int(db.features.shape[0]) * int(db.features.shape[2]),
+                    int(db.score_pos.shape[0]),
+                )
+                for db in self.device_buckets
+            ),
+            default=1,
+        )
+        fold = spmd.CommAllowance(
+            ops=(
+                "all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute",
+            ),
+            max_bytes_per_site=max(rows, per_bucket + 64) * itemsize,
+            reason=(
+                "RE score fold: per-bucket table/position gathers and "
+                "one [n]-row reduce per site (solves themselves are "
+                "collective-free, pinned on the train program)"
+            ),
+        )
+        return spmd.SpmdContract(
+            comm=spmd.COLLECTIVE_FREE,
+            sharding=spmd.ShardingContract(
+                on_mesh=True,
+                # only λ and other scalars may replicate; every entity
+                # block and every per-sample column is sharded
+                replicated_bytes_limit=4 * 1024,
+                partitioned_params=True,
+                partitioned_results=True,
+            ),
+            comm_overrides={"sweep": fold, "score": fold},
+        )
+
     def sweep_step(self, total: Array, score: Array, state: list[Array],
                    donate=None):
         dispatch_count.record(1)
-        reg_w = jnp.asarray(
-            self.problem_config.regularization_weight, self.dtype
-        )
+        reg_w = self._reg_scalar(self.problem_config.regularization_weight)
         d = bool(donate) if donate is not None else sweep_donation_enabled()
         out = self._aot_call(
             ("sweep", d), self._train_args(), self._score_args(), total,
@@ -1089,6 +1244,9 @@ class MatrixFactorizationCoordinate(Coordinate):
     l2_weight: float
     dtype: object
     seed: int
+    #: set when the per-sample columns are row-sharded over a device mesh
+    #: (the factor tables replicate) — declared in ``spmd_contract``
+    mesh: object = None
 
     @staticmethod
     def build(
@@ -1134,6 +1292,7 @@ class MatrixFactorizationCoordinate(Coordinate):
             l2_weight=float(config.regularization_weights[0]),
             dtype=dtype,
             seed=seed,
+            mesh=mesh,
             **arrays,
         )
 
@@ -1149,6 +1308,17 @@ class MatrixFactorizationCoordinate(Coordinate):
         scale = self.config.init_scale / np.sqrt(k)
         u = rng.normal(scale=scale, size=(len(self.row_vocab), k))
         v = rng.normal(scale=scale, size=(len(self.col_vocab), k))
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # factor tables replicate ON THE MESH (see spmd_contract) —
+            # matching the per-sample columns' placement up front avoids
+            # an implicit reshard at the first sweep dispatch
+            rep = NamedSharding(self.mesh, P())
+            return (
+                jax.device_put(u.astype(jnp.dtype(self.dtype)), rep),
+                jax.device_put(v.astype(jnp.dtype(self.dtype)), rep),
+            )
         return (
             jnp.asarray(u, dtype=self.dtype),
             jnp.asarray(v, dtype=self.dtype),
@@ -1227,7 +1397,7 @@ class MatrixFactorizationCoordinate(Coordinate):
             residual_scores,
             state[0],
             state[1],
-            jnp.asarray(self.l2_weight, self.dtype),
+            self._reg_scalar(self.l2_weight),
         )
         return (u, v), res
 
@@ -1293,6 +1463,36 @@ class MatrixFactorizationCoordinate(Coordinate):
             self._state_sds_pair(),
         )
 
+    def spmd_contract(self):
+        """MF on a mesh data-parallelizes the sample axis while both
+        factor tables replicate, so the joint L-BFGS psums ONE packed
+        (R·k + C·k) gradient per iteration — allowance priced at exactly
+        that; the replicated limit covers the two factor tables riding as
+        (replicated) state parameters."""
+        from photon_tpu.analysis import spmd
+
+        if self.mesh is None:
+            return spmd.SpmdContract()
+        itemsize = int(jnp.dtype(self.dtype).itemsize)
+        k = int(self.config.num_factors)
+        packed = (len(self.row_vocab) + len(self.col_vocab)) * k + 16
+        return spmd.SpmdContract(
+            comm=spmd.CommAllowance(
+                ops=("all-reduce",),
+                max_bytes_per_site=packed * itemsize,
+                reason=(
+                    "MF joint solve: one packed (R·k + C·k) factor "
+                    "gradient reduce per iteration"
+                ),
+            ),
+            sharding=spmd.ShardingContract(
+                on_mesh=True,
+                replicated_bytes_limit=2 * packed * itemsize,
+                partitioned_params=True,
+                partitioned_results=True,
+            ),
+        )
+
     def sweep_step(self, total: Array, score: Array, state, donate=None):
         dispatch_count.record(1)
         args = (
@@ -1300,7 +1500,7 @@ class MatrixFactorizationCoordinate(Coordinate):
             total,
             score,
             state,
-            jnp.asarray(self.l2_weight, self.dtype),
+            self._reg_scalar(self.l2_weight),
         )
         d = bool(donate) if donate is not None else sweep_donation_enabled()
         out = self._aot_call(("sweep", d), *args)

@@ -32,6 +32,7 @@ from photon_tpu.game.coordinate import Coordinate, sweep_donation_enabled
 from photon_tpu.obs.health import DivergenceError, resolve_policy
 from photon_tpu.util import compile_watch, dispatch_count
 from photon_tpu.util.force import fetch_scalars, force
+from photon_tpu.util.sanitize import sanctioned_transfers, transfer_sanitizer
 
 logger = logging.getLogger(__name__)
 
@@ -422,7 +423,13 @@ def run_coordinate_descent(
         #: cid → the step's {loss, gnorm, finite} device scalars (None
         #: where the coordinate kind can't fold them collective-free)
         health_dev: dict[str, dict | None] = {}
-        with obs.span("descent.sweep", iteration=it) as sweep_span:
+        # the transfer sanitizer (PHOTON_SANITIZE=transfers, a no-op
+        # otherwise) makes any IMPLICIT host transfer inside the
+        # steady-state sweep fail loudly; the sanctioned crossings below
+        # open explicit, reasoned escapes (util/sanitize.py)
+        with obs.span(
+            "descent.sweep", iteration=it
+        ) as sweep_span, transfer_sanitizer("descent.sweep"):
             for cid in trainable:
                 if cid in halted:
                     continue
@@ -455,7 +462,11 @@ def run_coordinate_descent(
                         # at enqueue over the relay, util/force.py) —
                         # opt-in: it costs a blocking round trip per
                         # coordinate per sweep
-                        force(new_score)
+                        with sanctioned_transfers(
+                            "per-coordinate profiling barrier (opt-in "
+                            "tracker_granularity='coordinate' read-back)"
+                        ):
+                            force(new_score)
                 elapsed = coord_span.duration_s
                 obs.counter("descent.coordinate_steps")
                 tracker.append(
@@ -480,12 +491,20 @@ def run_coordinate_descent(
                 # rescore), and the health scalars ride home IN that
                 # same fetch — still exactly one read-back per sweep
                 with obs.span("descent.barrier", iteration=it) as bar_span:
-                    health = _read_health(health_dev, barrier=total)
+                    with sanctioned_transfers(
+                        "THE per-sweep barrier read-back — health scalars "
+                        "ride the one sanctioned sync (util/force."
+                        "fetch_scalars)"
+                    ):
+                        health = _read_health(health_dev, barrier=total)
                 barrier_s = bar_span.duration_s
             else:
                 # profiling mode already paid a round trip per
                 # coordinate; the health fetch is one more
-                health = _read_health(health_dev, barrier=None)
+                with sanctioned_transfers(
+                    "per-coordinate profiling mode health fetch"
+                ):
+                    health = _read_health(health_dev, barrier=None)
             # phase-boundary live-buffer census (host metadata only — a
             # gated no-op that never dispatches or reads back; see
             # photon_tpu/obs/memory.py)

@@ -70,6 +70,7 @@ from photon_tpu.game.model import (
     RandomEffectModel,
 )
 from photon_tpu.util import compile_watch
+from photon_tpu.util.sanitize import sanctioned_transfers, transfer_sanitizer
 
 logger = logging.getLogger(__name__)
 
@@ -307,6 +308,14 @@ class GameScorer:
         #: ``lower().compile()`` does not feed the jit call cache, so the
         #: dispatch path consults this cache first)
         self._aot: dict = {}
+
+    def aot_executables(self) -> dict:
+        """The per-batch-shape AOT executables, keyed by ELL-width shape
+        signature — the same accessor contract as
+        ``Coordinate.aot_executables``, so the SPMD program auditor
+        (``analysis.hlo.audit_scorer``) covers the streaming scorer's
+        fused programs exactly like the fit's."""
+        return self._aot
 
     # -- model packing ------------------------------------------------------
 
@@ -626,9 +635,13 @@ class GameScorer:
             dev_scores, chunk, t_dispatch = pending
             with obs.span("score.readback", rows=chunk.num_samples):
                 obs.memory.count_d2h(int(dev_scores.nbytes))
-                scores = np.asarray(dev_scores)[: chunk.num_samples].astype(
-                    np.float64
-                )
+                with sanctioned_transfers(
+                    "score read-back — the one sanctioned D2H of the "
+                    "double-buffered pipeline"
+                ):
+                    scores = np.asarray(dev_scores)[
+                        : chunk.num_samples
+                    ].astype(np.float64)
             wall = time.perf_counter() - t_dispatch
             if not stats.batch_walls_s:
                 stats.compiles_first_batch = compile_watch.delta(cw_start)
@@ -644,7 +657,14 @@ class GameScorer:
                 with obs.span("score.write", rows=chunk.num_samples):
                     on_batch(chunk, scores)
 
-        with obs.span("score.stream") as root:
+        # the transfer sanitizer (PHOTON_SANITIZE=transfers, a no-op
+        # otherwise): any IMPLICIT host transfer in the consumer loop —
+        # a numpy leaf sneaking into a dispatch, a stray float() — fails
+        # loudly; the H2D staging and the score read-back are the two
+        # sanctioned, annotated crossings
+        with obs.span("score.stream") as root, transfer_sanitizer(
+            "score.stream"
+        ):
             # phase-boundary censuses: what is live on device at stream
             # start/end (model tables should be the whole bill; batches
             # must NOT accumulate) — host metadata only, never a sync
@@ -673,7 +693,11 @@ class GameScorer:
                             "score.padded_rows",
                             self.batch_rows - chunk.num_samples,
                         )
-                    with obs.span("score.h2d"):
+                    with obs.span("score.h2d"), sanctioned_transfers(
+                        "scoring H2D staging — the batch pytree is placed "
+                        "whole, explicitly, once per batch"
+                    ):
+                        # phl-ok: PHL007 single-host scoring engine: the batch is placed on the default device; a mesh-sharded scorer must pass shardings here
                         batch_dev = jax.device_put(host_batch)
                         # ingest choke point: the batch's H2D bill (from
                         # placed-handle metadata — free, gated no-op)

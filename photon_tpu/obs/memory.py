@@ -253,6 +253,14 @@ def record_executable(label: str, compiled) -> dict:
     return _ledger.record_executable(label, compiled)
 
 
+def executable_footprints() -> dict:
+    """label → static footprint for every recorded executable — the
+    read-side join the SPMD program auditor uses to print one
+    compute/memory/comms row per program (``python -m
+    photon_tpu.analysis --programs``)."""
+    return _ledger.report()["executables"]
+
+
 def census(phase: str) -> dict | None:
     """Module-level census on the default ledger — a no-op while the
     ledger is gated off, so phase-boundary call sites stay one-liners

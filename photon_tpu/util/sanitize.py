@@ -1,0 +1,78 @@
+"""Transfer-guard sanitizer: make implicit host transfers fail loudly.
+
+The sync-free steady state (PR 2) and the zero-host-residency streaming
+claims are enforced socially by photon-lint's PHL002 and empirically by
+the dispatch/read-back counters — but neither catches an *implicit*
+transfer jax performs on the hot path's behalf (a numpy leaf silently
+entering a compiled dispatch, a Python scalar re-placed every step, a
+stray ``float()`` on a device value). ``PHOTON_SANITIZE=transfers``
+turns those into hard errors: descent's steady-state sweep loop and
+``GameScorer.stream`` run under ``jax.transfer_guard("disallow")``, with
+annotated escapes at exactly the sanctioned crossings (the one per-sweep
+barrier read-back, the scoring H2D staging and score read-back).
+
+Semantics on this jax: the ``disallow`` guard blocks IMPLICIT transfers
+— explicit ``jax.device_put`` stays legal, which is the point (every
+intentional placement in this codebase is explicit). On XLA:CPU the
+guard bites on host→device crossings (device→host literal reads share
+host memory and bypass it); on real device backends it polices both
+directions, which is why the sanctioned read-backs are annotated even
+though the CPU CI lane never needs the escape.
+
+The sanitizer is opt-in and costs one env read per guarded region when
+off — the CI lane runs the 8-virtual-device mesh tests under it
+(``PHOTON_SANITIZE=transfers``), so any implicit transfer a refactor
+adds to a compiled hot path fails the build, not a profile review.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Iterator
+
+__all__ = ["sanctioned_transfers", "transfer_sanitizer", "transfers_mode"]
+
+_MODE_ENV = "PHOTON_SANITIZE"
+
+
+def transfers_mode() -> bool:
+    """True when ``PHOTON_SANITIZE`` requests the transfer sanitizer
+    (value ``transfers``, or ``1`` as shorthand). Read per guarded
+    region so tests can flip it with monkeypatch."""
+    return os.environ.get(_MODE_ENV, "").strip() in ("transfers", "1")
+
+
+@contextmanager
+def transfer_sanitizer(region: str) -> Iterator[None]:
+    """Run ``region`` under ``jax.transfer_guard("disallow")`` when the
+    sanitizer is enabled; a zero-cost no-op otherwise. ``region`` names
+    the guarded hot path in the error a violation raises (jax's own
+    message carries the aval; the region comes from the enclosing
+    span/stack)."""
+    if not transfers_mode():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@contextmanager
+def sanctioned_transfers(reason: str) -> Iterator[None]:
+    """An annotated escape inside a sanitized region — the analogue of a
+    ``# phl-ok`` annotation, but enforced at runtime scope: the reason is
+    mandatory and the allow window is exactly the ``with`` body. Used at
+    the per-sweep barrier read-back and the scoring H2D/read-back."""
+    if not reason or not reason.strip():
+        raise ValueError(
+            "sanctioned_transfers requires a reason — an unexplained "
+            "escape defeats the sanitizer"
+        )
+    if not transfers_mode():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard("allow"):
+        yield

@@ -1,0 +1,15 @@
+"""PHL007 positive: un-sharded placements in mesh-scoped code — the
+silently-replicated-entity-table shape the SPMD auditor pins compiled."""
+import jax
+import numpy as np
+
+
+def place_entity_table(table):
+    # no sharding: the [E, n, d] block commits to the default device and
+    # replicates under a mesh
+    return jax.device_put(table)
+
+
+def place_batch(rows):
+    dev = jax.device_put(np.asarray(rows))
+    return dev

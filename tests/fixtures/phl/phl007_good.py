@@ -1,0 +1,17 @@
+"""PHL007 negative: every placement names its layout (or its device)."""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def place_entity_table(table, mesh):
+    return jax.device_put(table, NamedSharding(mesh, P("entity")))
+
+
+def place_batch(rows, mesh):
+    return jax.device_put(rows, device=NamedSharding(mesh, P(("data",))))
+
+
+def place_replicated(x, mesh):
+    # full replication is fine when DECLARED — the rule polices silence,
+    # not the layout choice
+    return jax.device_put(x, NamedSharding(mesh, P()))

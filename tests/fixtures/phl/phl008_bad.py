@@ -1,0 +1,15 @@
+"""PHL008 positive: shard_map call sites that leave out_specs to
+inference — inside an unchecked region nothing stops the output layout
+from flipping to replicated."""
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from photon_tpu.parallel.mesh import shard_map_unchecked
+
+
+def solve_entities(body, mesh):
+    return shard_map(body, mesh=mesh, in_specs=(P("entity"),))
+
+
+def solve_unchecked(body, mesh):
+    return shard_map_unchecked(body, mesh=mesh, in_specs=(P("entity"),))

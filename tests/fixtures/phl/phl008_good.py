@@ -1,0 +1,22 @@
+"""PHL008 negative: out_specs declared at every call site, keyword or
+positional."""
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from photon_tpu.parallel.mesh import shard_map_unchecked
+
+
+def solve_entities(body, mesh):
+    return shard_map(
+        body, mesh=mesh, in_specs=(P("entity"),), out_specs=P("entity")
+    )
+
+
+def solve_positional(body, mesh):
+    return shard_map(body, mesh, (P("entity"),), P("entity"))
+
+
+def solve_unchecked(body, mesh):
+    return shard_map_unchecked(
+        body, mesh=mesh, in_specs=(P("entity"),), out_specs=P("entity")
+    )

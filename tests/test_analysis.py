@@ -27,14 +27,17 @@ from photon_tpu.analysis.core import default_scan_files, is_hot_path
 REPO = Path(__file__).resolve().parents[1]
 FIXTURES = REPO / "tests" / "fixtures" / "phl"
 
-ALL_RULES = ("PHL001", "PHL002", "PHL003", "PHL004", "PHL005", "PHL006")
+ALL_RULES = (
+    "PHL001", "PHL002", "PHL003", "PHL004", "PHL005", "PHL006",
+    "PHL007", "PHL008",
+)
 
 
 def _findings(name: str, rule: str):
     src = (FIXTURES / name).read_text()
     return [
         f
-        for f in analyze_source(src, name, hot=True)
+        for f in analyze_source(src, name, hot=True, mesh_scoped=True)
         if f.rule == rule and f.status == "new"
     ]
 
@@ -151,6 +154,66 @@ def test_hot_path_scoping():
     assert not any(f.rule == "PHL002" for f in cold)
     assert is_hot_path("photon_tpu/optimize/lbfgs.py")
     assert not is_hot_path("photon_tpu/obs/tracer.py")
+
+
+def test_mesh_scoping_for_phl007():
+    """PHL007 fires in mesh-scoped modules (hot paths + parallel/) and
+    stays silent in probe scripts — a default-device put in gather_lab is
+    fine; in the sharding layer it is the replicated-table hazard. PHL008
+    is whole-tree (a shard_map call site is mesh code wherever it is)."""
+    from photon_tpu.analysis.core import is_mesh_scoped
+
+    assert is_mesh_scoped("photon_tpu/parallel/mesh.py")
+    assert is_mesh_scoped("photon_tpu/game/scoring.py")
+    assert not is_mesh_scoped("scripts/gather_lab.py")
+    src = "import jax\ndef f(x):\n    return jax.device_put(x)\n"
+    mesh_scoped = analyze_source(src, "photon_tpu/parallel/mesh.py")
+    script = analyze_source(src, "scripts/gather_lab.py")
+    assert any(f.rule == "PHL007" for f in mesh_scoped)
+    assert not any(f.rule == "PHL007" for f in script)
+    sm = (
+        "from photon_tpu.parallel.mesh import shard_map\n"
+        "def g(f, mesh, spec):\n"
+        "    return shard_map(f, mesh=mesh, in_specs=(spec,))\n"
+    )
+    assert any(
+        f.rule == "PHL008" for f in analyze_source(sm, "scripts/whatever.py")
+    )
+
+
+def test_phl007_accepts_positional_and_kwarg_targets():
+    base = "import jax\ndef f(x, s):\n    return jax.device_put(x{})\n"
+    for ok in (", s", ", device=s", ", sharding=s"):
+        found = [
+            f
+            for f in analyze_source(
+                base.format(ok), "x.py", mesh_scoped=True
+            )
+            if f.rule == "PHL007"
+        ]
+        assert not found, f"PHL007 false-positive on device_put(x{ok})"
+    # the scopes are independent: forcing hot must not force mesh scope
+    bad = base.format("")
+    assert not [
+        f for f in analyze_source(bad, "x.py", hot=True)
+        if f.rule == "PHL007"
+    ]
+    assert [
+        f for f in analyze_source(bad, "x.py", mesh_scoped=True)
+        if f.rule == "PHL007"
+    ]
+
+
+def test_phl008_accepts_positional_out_specs():
+    src = (
+        "from jax.experimental.shard_map import shard_map\n"
+        "def g(f, mesh, si, so):\n"
+        "    return shard_map(f, mesh, si, so)\n"
+    )
+    assert not [
+        f for f in analyze_source(src, "x.py", hot=True)
+        if f.rule == "PHL008"
+    ]
 
 
 def test_annotation_requires_reason():

@@ -1,0 +1,494 @@
+"""SPMD program auditor + transfer-guard sanitizer (analysis/spmd.py,
+util/sanitize.py) on the 8-virtual-device CPU mesh.
+
+The census/contract pins run at all three program levels — jaxpr
+(explicit collective primitives), lowered (StableHLO text), compiled
+(post-optimization HLO text) — exactly like the constant-embedding
+meta-test: a planted accidental all-gather in an RE-like program must
+fail the gate at every level, the FE sharded solve's bounded d-vector
+all-reduce must pass, and a replicated-entity-table build must fail the
+sharding contract.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.analysis import hlo, spmd
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.coordinate import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_tpu.game.data import (
+    CSRMatrix,
+    GameData,
+    build_random_effect_dataset,
+)
+from photon_tpu.game.descent import precompile_coordinates
+from photon_tpu.optimize import OptimizerConfig
+from photon_tpu.optimize.problem import GLMProblemConfig
+from photon_tpu.parallel.mesh import (
+    ENTITY_AXIS,
+    make_mesh,
+    shard_map_unchecked,
+)
+from photon_tpu.types import TaskType
+from photon_tpu.util.sanitize import sanctioned_transfers, transfer_sanitizer
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+# --- census + contract units (synthetic module text) ----------------------
+
+
+HLO_TEXT = """\
+%all-reduce = f32[32]{0} all-reduce(f32[32]{0} %x), channel_id=1, replica_groups=[1,8]<=[8], to_apply=%sum
+%all-gather = f32[64,4]{1,0} all-gather(f32[8,4]{1,0} %p), dimensions={0}, replica_groups={{0,1,2,3,4,5,6,7}}
+%param = f32[2,4]{1,0} parameter(0), sharding={devices=[8,1]<=[8]}, metadata={op_name="t"}
+%param.1 = f32[1024,16]{1,0} parameter(1), sharding={replicated}
+%param.2 = f32[] parameter(2), sharding={replicated}
+"""
+
+SHLO_TEXT = (
+    'func.func public @main(%arg0: tensor<16x4xf32> '
+    '{mhlo.sharding = "{devices=[8,1]<=[8]}"}) {\n'
+    '  %1 = "stablehlo.all_gather"(%0) <{replica_groups = dense<[[0,1]]> : '
+    "tensor<1x2xi64>}> : (tensor<8x4xf32>) -> tensor<16x4xf32>\n"
+    "}\n"
+)
+
+
+def test_census_prices_both_dialects():
+    sites = spmd.communication_census(HLO_TEXT)
+    assert [(s.op, s.nbytes) for s in sites] == [
+        ("all-reduce", 128),
+        ("all-gather", 1024),
+    ]
+    assert sites[0].replica_groups == "[1,8]<=[8]"  # iota format
+    assert sites[1].replica_groups == "{{0,1,2,3,4,5,6,7}}"  # list format
+    (s,) = spmd.communication_census(SHLO_TEXT)
+    assert (s.op, s.nbytes) == ("all-gather", 256)  # 16*4*4
+    assert "dense<[[0,1]]>" in s.replica_groups
+    assert spmd.communication_census("%1 = f32[8] add(%a, %b)") == []
+    # async pairs: -done skipped, -start's aliased (operand, result)
+    # tuple priced ONCE — a plain sum would double the payload and
+    # falsely breach a tight per-site allowance
+    async_text = (
+        "%ars = (f32[1024]{0}, f32[1024]{0}) all-reduce-start(f32[1024]{0} "
+        "%p), replica_groups=[1,8]<=[8], to_apply=%sum\n"
+        "%ard = f32[1024]{0} all-reduce-done((f32[1024]{0}, f32[1024]{0}) "
+        "%ars)\n"
+    )
+    (a,) = spmd.communication_census(async_text)
+    assert (a.op, a.nbytes) == ("all-reduce", 4096)
+
+
+def test_comm_allowance_ops_and_bytes():
+    sites = spmd.communication_census(HLO_TEXT)
+    # zero allowance: both sites fail
+    assert len(spmd.check_comm_allowance(sites, spmd.COLLECTIVE_FREE, "p")) == 2
+    # all-reduce allowed within bytes: only the all-gather fails
+    fe = spmd.CommAllowance(
+        ops=("all-reduce",), max_bytes_per_site=192, reason="d-vector"
+    )
+    bad = spmd.check_comm_allowance(sites, fe, "p")
+    assert len(bad) == 1 and "all-gather" in bad[0].message
+    # same family but over the byte bound fails too
+    tight = spmd.CommAllowance(
+        ops=("all-reduce", "all-gather"), max_bytes_per_site=64, reason="t"
+    )
+    assert len(spmd.check_comm_allowance(sites, tight, "p")) == 2
+    # the unconstrained census-only allowance gates nothing
+    assert spmd.check_comm_allowance(sites, spmd.ANY_COMM, "p") == []
+    # an unpriceable payload must fail a finite bound (not pass silently)
+    unk = [spmd.CollectiveSite("all-reduce", "?", None, "", 1)]
+    assert spmd.check_comm_allowance(
+        unk, spmd.CommAllowance(ops=("all-reduce",), max_bytes_per_site=1 << 20,
+                                reason="r"), "p"
+    )
+
+
+def test_parse_param_shardings_flags_replicated_tables():
+    params = spmd.parse_param_shardings(HLO_TEXT)
+    assert [(p.index, p.replicated) for p in params] == [
+        (0, False), (1, True), (2, True),
+    ]
+    assert params[1].nbytes == 1024 * 16 * 4
+    contract = spmd.ShardingContract(
+        on_mesh=True, replicated_bytes_limit=4096, partitioned_params=True
+    )
+    bad = spmd.check_sharding_contract(HLO_TEXT, "p", contract)
+    assert len(bad) == 1 and "replicated" in bad[0].message
+    # the scalar param stays under the limit; off-mesh contracts no-op
+    assert spmd.check_sharding_contract(
+        HLO_TEXT, "p", spmd.ShardingContract(on_mesh=False)
+    ) == []
+    # a module whose every annotated param is replicated fell off the mesh
+    all_rep = "\n".join(
+        ln for ln in HLO_TEXT.splitlines() if "parameter(1)" in ln or
+        "parameter(2)" in ln
+    )
+    loose = spmd.ShardingContract(
+        on_mesh=True, replicated_bytes_limit=1 << 30, partitioned_params=True
+    )
+    bad = spmd.check_sharding_contract(all_rep, "p", loose)
+    assert len(bad) == 1 and "fell off the mesh" in bad[0].message
+    # an UNPRICEABLE replicated parameter fails closed, like an
+    # unpriceable collective payload
+    weird = (
+        "%param = (f32[8]{0}, s32[]) parameter(0), sharding={replicated}\n"
+        "%param.1 = f32[2,4]{1,0} parameter(1), "
+        "sharding={devices=[8,1]<=[8]}\n"
+    ).replace("(f32[8]{0}, s32[])", "f8e4m3fn[400000000,8]{1,0}")
+    hits = spmd.check_sharding_contract(
+        weird, "p", spmd.ShardingContract(on_mesh=True,
+                                          replicated_bytes_limit=1 << 30)
+    )
+    assert len(hits) == 1 and "unpriceable" in hits[0].message
+
+
+# --- fixtures: real meshed coordinates ------------------------------------
+
+
+def _game_data(n=256, fe_dim=16, users=24, d_re=4, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, users, size=n)
+    return GameData.build(
+        labels=rng.normal(size=n),
+        feature_shards={
+            "global": CSRMatrix.from_dense(
+                rng.normal(size=(n, fe_dim)).astype(np.float32)
+            ),
+            "per_user": CSRMatrix.from_dense(
+                rng.normal(size=(n, d_re)).astype(np.float32)
+            ),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+
+
+def _opt():
+    return GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=3),
+    )
+
+
+def _re_coordinate(mesh, data=None):
+    cfg = RandomEffectCoordinateConfig(
+        random_effect_type="userId", feature_shard="per_user",
+        optimization=_opt(), regularization_weights=(0.1,),
+    )
+    data = data if data is not None else _game_data()
+    ds = build_random_effect_dataset(
+        data, cfg, entity_shards=mesh.shape[ENTITY_AXIS] if mesh else 1
+    )
+    return RandomEffectCoordinate.build(
+        data, ds, cfg, jnp.float32, mesh=mesh
+    )
+
+
+def _fe_coordinate(mesh, data=None):
+    cfg = FixedEffectCoordinateConfig(
+        feature_shard="global", optimization=_opt(),
+        regularization_weights=(0.1,),
+    )
+    data = data if data is not None else _game_data()
+    return FixedEffectCoordinate.build(
+        data, cfg, dtype=jnp.float32, mesh=mesh
+    )
+
+
+@pytest.mark.slow
+def test_meshed_fit_passes_the_audit_fe_reduces_re_stays_bounded():
+    """The FE sharded solve's bounded d-vector all-reduce PASSES; the RE
+    programs pass with their solve collective-free and the score fold
+    within its allowance; entity tables are partitioned at placement,
+    in the compiled parameters, and in the results."""
+    mesh = make_mesh(num_data=1, num_entity=8)
+    data = _game_data()
+    coords = {
+        "global": _fe_coordinate(mesh, data),
+        "per_user": _re_coordinate(mesh, data),
+    }
+    precompile_coordinates(coords)
+    report = hlo.audit_coordinates(coords)
+    assert report.programs_checked >= 4
+    assert report.ok, "\n".join(f.render() for f in report.findings)
+    by_label = {row["program"]: row for row in report.comm}
+    fe_sweeps = [
+        r for label, r in by_label.items() if label.startswith("global:sweep")
+    ]
+    assert fe_sweeps and fe_sweeps[0]["collective_sites"], (
+        "the FE sharded solve should genuinely all-reduce — an empty "
+        "census here means the audit proved nothing"
+    )
+    assert all(
+        s["op"] == "all-reduce" for s in fe_sweeps[0]["collective_sites"]
+    )
+    # flops priced, payloads priced
+    assert fe_sweeps[0]["flops"] and fe_sweeps[0]["comm_bytes"] > 0
+
+
+@pytest.mark.slow
+def test_planted_all_gather_fails_at_every_level():
+    """An accidental all-gather in an RE-like per-entity program must be
+    caught at the jaxpr level (explicit primitive), the lowered level
+    (StableHLO text), and the compiled level (HLO text) — and it must
+    fail the whole-fit audit when such a program is among a coordinate's
+    executables."""
+    mesh = make_mesh(num_data=1, num_entity=8)
+    ent = NamedSharding(mesh, P("entity"))
+
+    def leaky_solve(tables):
+        # per-entity body that "accidentally" gathers the whole table
+        gathered = jax.lax.all_gather(tables, ENTITY_AXIS, tiled=True)
+        return tables * 2.0 + jnp.sum(gathered) * 0.0
+
+    fn = jax.jit(
+        shard_map_unchecked(
+            leaky_solve, mesh=mesh, in_specs=P("entity"),
+            out_specs=P("entity"),
+        )
+    )
+    sds = jax.ShapeDtypeStruct((16, 4), jnp.float32, sharding=ent)
+    # jaxpr level: the explicit primitive is visible before lowering
+    jaxpr = jax.make_jaxpr(fn)(jnp.ones((16, 4), jnp.float32))
+    assert spmd.find_jaxpr_collectives(jaxpr) == ["all_gather"]
+    assert spmd.check_jaxpr_no_collectives(jaxpr, "leaky")
+    lowered = fn.lower(sds)
+    # lowered level: StableHLO text
+    low_sites = spmd.communication_census(lowered.as_text())
+    assert any(s.op == "all-gather" for s in low_sites), lowered.as_text()
+    # compiled level: post-optimization HLO text
+    compiled = lowered.compile()
+    sites = spmd.communication_census(compiled.as_text())
+    assert any(s.op == "all-gather" for s in sites)
+    # and through the whole-fit audit: plant it among an RE coordinate's
+    # executables under a solve-kind key (the collective-free scope)
+    coord = _re_coordinate(mesh)
+    coord.aot_executables()[("train",)] = compiled
+    report = hlo.audit_coordinates({"per_user": coord})
+    assert not report.ok
+    assert any(
+        f.check == "comm-allowance" and "all-gather" in f.message
+        for f in report.findings
+    )
+
+
+@pytest.mark.slow
+def test_replicated_entity_table_fails_the_sharding_contract():
+    """The silent failure the contract exists for: the same RE build
+    lowered with its state tables REPLICATED compiles fine and computes
+    the same numbers — the audit must fail it."""
+    mesh = make_mesh(num_data=1, num_entity=8)
+    # uniform entity sizes → ONE bucket whose [E, d] state table (400×8×4
+    # = 12.8 KB) is bigger than the contract's replicated-scalar limit
+    rng = np.random.default_rng(1)
+    users, per_user, d_re = 400, 2, 8
+    n = users * per_user
+    ids = np.repeat(np.arange(users), per_user)
+    data = GameData.build(
+        labels=rng.normal(size=n),
+        feature_shards={
+            "global": CSRMatrix.from_dense(
+                rng.normal(size=(n, 8)).astype(np.float32)
+            ),
+            "per_user": CSRMatrix.from_dense(
+                rng.normal(size=(n, d_re)).astype(np.float32)
+            ),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+    coord = _re_coordinate(mesh, data)
+    rep = NamedSharding(mesh, P())
+    # simulate the accidental lowering: state sds stripped of their
+    # entity sharding (replicated), as a refactor dropping the sharding
+    # plumbing would produce
+    coord._state_sds_list = lambda: [
+        jax.ShapeDtypeStruct(
+            (db.features.shape[0], db.features.shape[2]), coord.dtype,
+            sharding=rep,
+        )
+        for db in coord.device_buckets
+    ]
+    specs = coord.precompile_specs(donate=False, include_score=False)
+    for key, _label, lowered in specs:
+        coord.aot_executables()[key] = lowered.compile()
+    report = hlo.audit_coordinates({"per_user": coord})
+    assert any(
+        f.check == "sharding-contract" for f in report.findings
+    ), "\n".join(f.render() for f in report.findings) or "audit passed"
+
+
+def test_table_placement_check_catches_replicated_residency():
+    mesh = make_mesh(num_data=1, num_entity=8)
+    coord = _re_coordinate(mesh)
+    assert spmd.check_table_placement({"u": coord}) == []
+
+    class FakeBucket:
+        def __init__(self, arr):
+            self.features = arr
+
+    class FakeCoord:
+        def __init__(self, arr, m):
+            self.mesh = m
+            self.device_buckets = [FakeBucket(arr)]
+
+    replicated = jax.device_put(
+        np.zeros((16, 4, 4), np.float32), NamedSharding(mesh, P())
+    )
+    findings = spmd.check_table_placement({"u": FakeCoord(replicated, mesh)})
+    assert findings and "FULLY REPLICATED" in findings[0].message
+
+
+def test_unreadable_module_text_is_skipped_with_warning():
+    class Unprintable:
+        def as_text(self):
+            raise NotImplementedError("serialization not supported here")
+
+    class StubCoord:
+        mesh = None
+
+        def aot_executables(self):
+            return {("sweep", False): Unprintable()}
+
+    report = hlo.audit_coordinates({"stub": StubCoord()})
+    assert report.programs_checked == 1
+    assert report.ok  # skipped, not failed...
+    assert report.skipped and "NotImplementedError" in (
+        report.skipped[0]["reason"]
+    )
+    # ...and try_module_text is the seam
+    text, err = hlo.try_module_text(Unprintable())
+    assert text is None and "serialization" in err
+
+
+@pytest.mark.slow
+def test_scorer_executables_are_audited():
+    from photon_tpu.analysis.cli import (
+        build_canonical_fixture,
+        build_scorer_fixture,
+    )
+
+    coords = build_canonical_fixture()
+    scorer = build_scorer_fixture(coords)
+    report = hlo.audit_scorer(scorer)
+    assert report.programs_checked == 1
+    assert report.ok, "\n".join(f.render() for f in report.findings)
+    assert report.comm and report.comm[0]["program"].startswith("score:")
+    # the ledger join target exists: GameScorer.precompile recorded its
+    # static footprint under the same label
+    from photon_tpu.obs import memory as obs_memory
+
+    label = report.comm[0]["ledger_label"]
+    assert label in obs_memory.executable_footprints()
+
+
+# --- transfer-guard sanitizer ---------------------------------------------
+
+
+def test_sanitizer_off_is_a_no_op(monkeypatch):
+    monkeypatch.delenv("PHOTON_SANITIZE", raising=False)
+    with transfer_sanitizer("test"):
+        jax.jit(lambda x: x * 2)(np.ones(4, np.float32))  # implicit H2D ok
+
+
+def test_sanitizer_catches_implicit_transfer(monkeypatch):
+    monkeypatch.setenv("PHOTON_SANITIZE", "transfers")
+    f = jax.jit(lambda x: x * 2)
+    dev = jnp.ones(4, jnp.float32)  # created OUTSIDE the guard
+    f(dev)  # warm
+    with transfer_sanitizer("test"):
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            f(np.ones(4, np.float32))  # numpy leaf → implicit H2D
+        # device inputs stay legal
+        f(dev)
+        # sanctioned escapes open exactly their with-body
+        with sanctioned_transfers("test escape"):
+            f(np.ones(4, np.float32))
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            f(np.ones(4, np.float32))
+    with pytest.raises(ValueError):
+        with sanctioned_transfers("  "):
+            pass
+
+
+def test_descent_steady_state_runs_under_sanitizer(monkeypatch):
+    """A fused fit completes under PHOTON_SANITIZE=transfers — the only
+    host crossings in the steady state are the sanctioned barrier and
+    the cached per-λ scalar placement."""
+    from photon_tpu.game.descent import run_coordinate_descent
+
+    monkeypatch.setenv("PHOTON_SANITIZE", "transfers")
+    data = _game_data(n=64, fe_dim=8, users=6, d_re=3)
+    coords = {
+        "global": _fe_coordinate(None, data),
+        "per_user": _re_coordinate(None, data),
+    }
+    result = run_coordinate_descent(coords, ["global", "per_user"], 2)
+    assert len(result.states) == 2
+    sweep_rows = [r for r in result.tracker if "sweep_seconds" in r]
+    assert len(sweep_rows) == 2
+    assert all(r["health"]["global"]["finite"] for r in sweep_rows)
+
+
+def test_descent_sanitizer_catches_planted_implicit_transfer(monkeypatch):
+    """A coordinate whose sweep step sneaks a numpy leaf into a compiled
+    dispatch fails loudly under the sanitizer (and only under it)."""
+    from photon_tpu.game.coordinate import Coordinate
+    from photon_tpu.game.descent import run_coordinate_descent
+
+    class LeakyCoordinate(Coordinate):
+        dtype = jnp.float32
+        _jit = staticmethod(jax.jit(lambda t, s: (t - s) * 1.0))
+
+        def initial_state(self):
+            return jnp.zeros((4,))
+
+        def score(self, state):
+            return jnp.zeros((8,))
+
+        def sweep_step(self, total, score, state, donate=None):
+            # the bug: a HOST numpy array rides into the dispatch
+            residual = self._jit(total, np.asarray(score))
+            return state, jnp.zeros((8,)), residual, None, None
+
+    def run():
+        return run_coordinate_descent(
+            {"leaky": LeakyCoordinate()}, ["leaky"], 1
+        )
+
+    monkeypatch.delenv("PHOTON_SANITIZE", raising=False)
+    run()  # silent without the sanitizer
+    monkeypatch.setenv("PHOTON_SANITIZE", "transfers")
+    with pytest.raises(Exception, match="[Dd]isallowed"):
+        run()
+
+
+@pytest.mark.slow
+def test_scorer_stream_runs_under_sanitizer(monkeypatch):
+    """The streaming scorer's consumer loop is sanitizer-clean: H2D
+    staging and the score read-back are its only (sanctioned) host
+    crossings."""
+    from photon_tpu.analysis.cli import (
+        build_canonical_fixture,
+        build_scorer_fixture,
+    )
+    from photon_tpu.game.data import slice_game_data
+
+    coords = build_canonical_fixture()
+    scorer = build_scorer_fixture(coords)
+    data = _game_data(n=256, fe_dim=32, users=24, d_re=6)
+    monkeypatch.setenv("PHOTON_SANITIZE", "transfers")
+    result = scorer.stream(
+        slice_game_data(data, lo, min(lo + 128, 256))
+        for lo in range(0, 256, 128)
+    )
+    assert result.stats.batches == 2
+    assert result.scores.shape == (256,)
