@@ -72,7 +72,45 @@ def build_parser() -> argparse.ArgumentParser:
         "(the pre-streaming path; also the automatic fallback for model "
         "layouts the fused scorer cannot express)",
     )
+    p.add_argument(
+        "--degrade-on-stream-failure",
+        action="store_true",
+        help="opt-in resilience escape: when the streaming pipeline "
+        "fails (repeated chunk decode failures past their retries, a "
+        "dead/hung producer), fall back to the monolithic path instead "
+        "of failing the run (env PHOTON_SCORE_DEGRADE=1). Off by "
+        "default: degrading trades bounded host memory for completion, "
+        "which must be an operator decision",
+    )
     return p
+
+
+def _degrade_enabled(args) -> bool:
+    env = os.environ.get("PHOTON_SCORE_DEGRADE", "").strip()
+    if env and env not in ("0", "1"):
+        # fail loudly: an operator who set =true believing the escape
+        # was armed must not discover otherwise via a dead run
+        raise ValueError(
+            f"PHOTON_SCORE_DEGRADE must be 0 or 1, got {env!r}"
+        )
+    if env:
+        return env == "1"
+    return bool(args.degrade_on_stream_failure)
+
+
+def _stream_degradable(exc: BaseException) -> bool:
+    """Which streaming failures the opt-in escape may absorb: pipeline
+    errors the monolithic path does not share (watchdog/producer death,
+    exhausted chunk retries — I/O and transient-transport classes).
+    Programming errors (shape/type/config) always propagate."""
+    from photon_tpu.game.scoring import StreamError
+    from photon_tpu.util.retry import is_transient, is_transient_io
+
+    return (
+        isinstance(exc, StreamError)
+        or is_transient_io(exc)
+        or is_transient(exc)
+    )
 
 
 def _run_evaluators(log, requested, scores, labels, weights, tag_cols) -> dict:
@@ -211,6 +249,10 @@ def _score_streaming(
 def run(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     game_base.ensure_single_process_jax()
+    # chaos: (re)install the PHOTON_FAULTS plan per driver run
+    from photon_tpu.util import faults
+
+    faults.install_from_env()
 
     shard_configs = game_base.parse_shard_configs(args)
     out_root = prepare_output_dir(
@@ -246,14 +288,48 @@ def run(argv=None) -> dict:
         }
         id_tags = sorted(model.required_id_tags() | evaluator_tags)
 
-        streamed = (
-            None
-            if args.monolithic_scoring
-            else _score_streaming(
-                args, log, model, index_maps, shard_configs, id_tags,
-                out_root, requested,
-            )
-        )
+        if args.monolithic_scoring:
+            streamed = None
+        else:
+            # knob validated BEFORE streaming: a bad PHOTON_SCORE_DEGRADE
+            # value must raise up front, not only on the failure path
+            degrade = _degrade_enabled(args)
+            try:
+                streamed = _score_streaming(
+                    args, log, model, index_maps, shard_configs, id_tags,
+                    out_root, requested,
+                )
+            except Exception as e:
+                # opt-in degrade-to-monolithic escape: a stream-only
+                # failure (dead producer, exhausted chunk retries) falls
+                # back to the materialize-everything path instead of
+                # failing the run — logged loudly, never silent
+                if not (degrade and _stream_degradable(e)):
+                    raise
+                from photon_tpu import obs
+
+                obs.counter("score.stream_degraded")
+                obs.instant(
+                    "score.stream_degraded",
+                    cat="lifecycle",
+                    error=f"{type(e).__name__}: {e}",
+                )
+                log.warning(
+                    "streaming scoring failed (%s: %s); degrading to the "
+                    "monolithic path (--degrade-on-stream-failure)",
+                    type(e).__name__, e,
+                )
+                # drop any partial streamed shards: the monolithic
+                # fallback writes part-00000.avro into the same
+                # directory, and a stale streamed part-0000N.avro
+                # holding a subset of rows would double-count for any
+                # consumer globbing part-*.avro
+                import shutil
+
+                shutil.rmtree(
+                    os.path.join(out_root, SCORES_DIR), ignore_errors=True
+                )
+                streamed = None
         if streamed is not None:
             scores, n, columns, score_detail = streamed
             log.info("scored %d samples (streaming)", n)

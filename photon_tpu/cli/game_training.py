@@ -149,6 +149,17 @@ def build_parser() -> argparse.ArgumentParser:
         choices=[t.name for t in DataValidationType],
     )
     p.add_argument(
+        "--max-restarts",
+        type=int,
+        default=None,
+        help="supervised auto-resume budget (game/recovery.py): restart "
+        "a fit that fails with a transient (UNAVAILABLE-class) or "
+        "divergent error up to this many times, resuming from the "
+        "newest valid checkpoint when --checkpoint-sweeps is set; fatal "
+        "errors never retry (default 0; env PHOTON_MAX_RESTARTS "
+        "overrides)",
+    )
+    p.add_argument(
         "--checkpoint-sweeps",
         action="store_true",
         help="flush coordinate-descent state to <output>/checkpoints after "
@@ -292,6 +303,12 @@ def _select_best(
 def run(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     game_base.ensure_single_process_jax()
+    # chaos: (re)install the PHOTON_FAULTS plan per driver run — the
+    # chaos drive (scripts/chaos_drive.py) controls faults through the
+    # child environment; unset env clears any leftover plan
+    from photon_tpu.util import faults
+
+    faults.install_from_env()
 
     task = TaskType[args.training_task]
     shard_configs = game_base.parse_shard_configs(args)
@@ -451,6 +468,10 @@ def run(argv=None) -> dict:
             # library-level lifecycle events (setup / sweep_complete /
             # training_finish / training_failure) ride the driver's bus
             events=emitter,
+            # supervised auto-resume: transient/divergent failures
+            # restart from the newest valid checkpoint (recovery.*
+            # events on the same bus/obs spine)
+            max_restarts=args.max_restarts,
         )
 
         emitter.emit("training_start", task=task.name)
@@ -495,7 +516,10 @@ def run(argv=None) -> dict:
                 grid_callback=grid_callback,
                 checkpoint_dir=ckpt_dir,
             )
-        if resuming and any(r is None for r in results):
+        # None placeholders appear on a cross-process resume AND after an
+        # in-process supervised restart that re-entered the grid loop
+        # past checkpointed grid points — restore from disk either way
+        if any(r is None for r in results):
             results = _restore_skipped_grid_results(
                 results, grid_results_path, out_root, index_maps, log
             )

@@ -12,27 +12,89 @@ models (descent is deterministic given the states: data layout, reservoir
 sampling and down-sampling all derive from the estimator's build-time
 seed, and residual scores are recomputed from the states on resume).
 
-Layout under ``<dir>/``:
-    descent-checkpoint.json       manifest (grid/iteration/metric/keys)
-    descent-state.npz             flattened per-coordinate arrays
-    descent-best.npz              best-by-validation snapshot (optional)
+Durability (PR 10): checkpoints are DURABLE, not merely atomic —
 
-Writes are atomic (tmp file + os.replace) so a crash mid-write leaves the
-previous checkpoint intact.
+* **Retention** — every save is a new sequence-numbered snapshot
+  (``descent-state-<seq>.npz`` + ``descent-manifest-<seq>.json``), and
+  the last ``keep`` snapshots are retained (``PHOTON_CHECKPOINT_KEEP``,
+  default 2) instead of overwriting one file in place. One bad write can
+  no longer destroy the only recovery point.
+* **Integrity** — each manifest carries a sha256 of its array files;
+  :meth:`DescentCheckpointer.load` verifies it before trusting a
+  snapshot.
+* **Fallback** — ``load()`` walks snapshots newest-first and falls back
+  past a torn or corrupt head to the newest VALID one, emitting a
+  ``recovery.checkpoint_fallback`` event; only when every snapshot is
+  corrupt does it raise :class:`CheckpointCorruptError` (naming the
+  files) — never a raw numpy/zipfile traceback, and never a silent
+  fresh start on top of salvageable state.
+
+Layout under ``<dir>/``:
+    descent-checkpoint.json         head manifest (copy of the newest
+                                    per-seq manifest; its presence is the
+                                    cheap resume probe drivers use)
+    descent-manifest-<seq>.json     per-snapshot manifest
+    descent-state-<seq>.npz         flattened per-coordinate arrays
+    descent-best-<seq>.npz          best-by-validation snapshot (optional)
+
+Writes are atomic (tmp file + os.replace) so a crash mid-write leaves
+every previous snapshot intact — pinned by the kill-mid-write chaos test
+via the ``checkpoint.replace`` fault point (util/faults.py).
 """
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import logging
 import os
+import re
 import tempfile
 
 import jax.numpy as jnp
 import numpy as np
 
+from photon_tpu import obs
+from photon_tpu.util import faults
+
+logger = logging.getLogger(__name__)
+
 MANIFEST = "descent-checkpoint.json"
+#: legacy single-snapshot layout (pre-retention): still loadable
 STATE_NPZ = "descent-state.npz"
 BEST_NPZ = "descent-best.npz"
+
+_SEQ_MANIFEST_RE = re.compile(r"descent-manifest-(\d{8})\.json$")
+_SEQ_NPZ_RE = re.compile(r"descent-(?:state|best)-(\d{8})\.npz$")
+
+DEFAULT_KEEP = 2
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file is torn, truncated, or fails its checksum. The
+    message names the file; ``path`` carries it for programmatic use.
+    The recovery layer (game/recovery.py) and ``load()``'s own fallback
+    catch exactly this type."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint file {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+def checkpoint_keep(value: int | None = None) -> int:
+    """Snapshots retained per checkpoint directory:
+    ``PHOTON_CHECKPOINT_KEEP`` env > explicit value > 2."""
+    env = os.environ.get("PHOTON_CHECKPOINT_KEEP", "").strip()
+    if env:
+        v = int(env)
+    elif value is not None:
+        v = int(value)
+    else:
+        return DEFAULT_KEEP
+    if v < 1:
+        raise ValueError(f"checkpoint keep must be >= 1, got {v}")
+    return v
 
 
 def _flatten_states(states: dict) -> dict[str, np.ndarray]:
@@ -77,18 +139,61 @@ def _structure_of(states: dict) -> dict:
     return out
 
 
-def _atomic_write_npz(path: str, arrays: dict) -> None:
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _atomic_write_npz(path: str, arrays: dict) -> str:
+    """Write ``arrays`` as an npz at ``path`` via tmp + rename; returns
+    the file's sha256 (hashed from the tmp file BEFORE the rename, so
+    the recorded checksum describes exactly the bytes that landed)."""
     fd, tmp = tempfile.mkstemp(
         dir=os.path.dirname(path) or ".", suffix=".tmp"
     )
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+        digest = _sha256_file(tmp)
+        # chaos hook: the kill-mid-write window — tmp fully written, the
+        # rename not yet done; the previous snapshot must stay loadable
+        faults.fault_point("checkpoint.replace")
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    return digest
+
+
+def _load_npz_checked(
+    path: str, structure: dict, checksum: str | None
+) -> dict:
+    """Load + unflatten one npz, converting every torn-file failure mode
+    (missing, truncated zip, missing member, checksum mismatch) into the
+    typed :class:`CheckpointCorruptError` the recovery layer catches."""
+    if not os.path.exists(path):
+        raise CheckpointCorruptError(path, "file missing")
+    if checksum is not None:
+        actual = _sha256_file(path)
+        if actual != checksum:
+            raise CheckpointCorruptError(
+                path,
+                f"sha256 mismatch (manifest {checksum[:12]}…, "
+                f"file {actual[:12]}…)",
+            )
+    try:
+        with np.load(path) as npz:
+            return _unflatten_states(npz, structure)
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # zipfile.BadZipFile, KeyError, OSError, ...
+        raise CheckpointCorruptError(
+            path, f"{type(e).__name__}: {e}"
+        ) from e
 
 
 @dataclasses.dataclass
@@ -106,12 +211,44 @@ class DescentCheckpointer:
     """Sweep callback writing checkpoints every ``every`` sweeps, plus the
     loader used by ``GameEstimator.fit(checkpoint_dir=...)``."""
 
-    def __init__(self, directory: str, every: int = 1):
+    def __init__(
+        self, directory: str, every: int = 1, keep: int | None = None
+    ):
         if every < 1:
             raise ValueError("checkpoint interval must be >= 1")
         self.directory = directory
         self.every = every
+        self.keep = checkpoint_keep(keep)
         os.makedirs(directory, exist_ok=True)
+        # continue the sequence a previous (killed) run left behind —
+        # a resumed run must never overwrite the snapshot it loaded from
+        seqs = self._existing_seqs()
+        self._next_seq = (seqs[-1] + 1) if seqs else 0
+
+    # -- paths ---------------------------------------------------------
+
+    def _state_path(self, seq: int) -> str:
+        return os.path.join(self.directory, f"descent-state-{seq:08d}.npz")
+
+    def _best_path(self, seq: int) -> str:
+        return os.path.join(self.directory, f"descent-best-{seq:08d}.npz")
+
+    def _manifest_path(self, seq: int) -> str:
+        return os.path.join(
+            self.directory, f"descent-manifest-{seq:08d}.json"
+        )
+
+    def _existing_seqs(self) -> list[int]:
+        seqs = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _SEQ_MANIFEST_RE.match(name)
+            if m:
+                seqs.append(int(m.group(1)))
+        return sorted(seqs)
 
     # -- saving --------------------------------------------------------
 
@@ -135,26 +272,87 @@ class DescentCheckpointer:
         self, grid_index, iteration, states, best_states, best_metric,
         *, fingerprint: str | None = None,
     ) -> None:
-        _atomic_write_npz(
-            os.path.join(self.directory, STATE_NPZ), _flatten_states(states)
-        )
+        faults.fault_point("checkpoint.write")
+        seq = self._next_seq
+        checksums = {
+            "state": _atomic_write_npz(
+                self._state_path(seq), _flatten_states(states)
+            )
+        }
         if best_states is not None:
-            _atomic_write_npz(
-                os.path.join(self.directory, BEST_NPZ),
-                _flatten_states(best_states),
+            checksums["best"] = _atomic_write_npz(
+                self._best_path(seq), _flatten_states(best_states)
             )
         manifest = {
+            "seq": seq,
             "grid_index": int(grid_index),
             "iteration": int(iteration),
             "best_metric": best_metric,
             "has_best": best_states is not None,
             "structure": _structure_of(states),
             "fingerprint": fingerprint,
+            "checksums": checksums,
         }
+        payload = json.dumps(manifest)
+        self._write_text_atomic(self._manifest_path(seq), payload)
+        # the head manifest is a COPY of the newest per-seq manifest:
+        # its presence is the cheap "is there a checkpoint?" probe, and
+        # both writes are atomic — a crash between them just means load()
+        # finds the per-seq manifest first (same snapshot either way)
+        self._write_text_atomic(
+            os.path.join(self.directory, MANIFEST), payload
+        )
+        self._next_seq = seq + 1
+        self._prune(seq)
+
+    def _write_text_atomic(self, path: str, text: str) -> None:
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
-        with os.fdopen(fd, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, os.path.join(self.directory, MANIFEST))
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def _prune(self, newest_seq: int) -> None:
+        """Drop snapshots older than the retention window, plus the
+        droppings a killed writer leaves behind: mkstemp ``*.tmp`` files
+        (SIGKILL in the write→replace window) and manifest-less npz
+        files below the cutoff (death between the state write and its
+        manifest) — without the sweep, every kill/relaunch cycle would
+        grow the directory past the nominal retention cap. Single
+        writer per checkpoint dir by contract, so a ``.tmp`` seen here
+        cannot belong to a live save. Pruning is best-effort — a
+        missing file (a previous prune died mid-way) must not fail the
+        save that just succeeded."""
+        cutoff = newest_seq - self.keep + 1
+        doomed: list[str] = []
+        for seq in self._existing_seqs():
+            if seq >= cutoff:
+                continue
+            doomed += [
+                self._manifest_path(seq),
+                self._state_path(seq),
+                self._best_path(seq),
+            ]
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            names = []
+        for name in names:
+            if name.endswith(".tmp"):
+                doomed.append(os.path.join(self.directory, name))
+                continue
+            m = _SEQ_NPZ_RE.match(name)
+            if m and int(m.group(1)) < cutoff:
+                doomed.append(os.path.join(self.directory, name))
+        for path in doomed:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def mark_grid_done(
         self, grid_index: int, states: dict, fingerprint: str | None = None
@@ -168,39 +366,114 @@ class DescentCheckpointer:
 
     # -- loading -------------------------------------------------------
 
-    def load(
-        self, expect_fingerprint: str | None = None
-    ) -> DescentCheckpoint | None:
-        """Load the checkpoint; when ``expect_fingerprint`` is given, a
-        mismatch with the stored fingerprint is a hard error — resuming
-        state trained under different hyperparameters would silently
-        return wrong models."""
-        mpath = os.path.join(self.directory, MANIFEST)
-        if not os.path.exists(mpath):
-            return None
-        with open(mpath) as f:
-            manifest = json.load(f)
-        stored = manifest.get("fingerprint")
-        if (
-            expect_fingerprint is not None
-            and stored is not None
-            and stored != expect_fingerprint
-        ):
-            raise ValueError(
-                "checkpoint was written under a different training "
-                "configuration; delete the checkpoint directory "
-                f"({self.directory}) to start fresh"
-            )
-        with np.load(os.path.join(self.directory, STATE_NPZ)) as npz:
-            states = _unflatten_states(npz, manifest["structure"])
+    def _candidate_manifests(self) -> list[str]:
+        """Manifest paths newest-first: per-seq manifests (descending
+        seq), then the legacy head-only layout if nothing sequenced
+        exists but a pre-retention ``descent-checkpoint.json`` does."""
+        seqs = self._existing_seqs()
+        out = [self._manifest_path(s) for s in reversed(seqs)]
+        head = os.path.join(self.directory, MANIFEST)
+        if not out and os.path.exists(head):
+            out.append(head)
+        return out
+
+    def _load_manifest(self, mpath: str) -> dict:
+        try:
+            with open(mpath) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                mpath, f"{type(e).__name__}: {e}"
+            ) from e
+
+    def _load_snapshot(self, manifest: dict) -> DescentCheckpoint:
+        checksums = manifest.get("checksums") or {}
+        if "seq" in manifest:
+            seq = int(manifest["seq"])
+            state_path = self._state_path(seq)
+            best_path = self._best_path(seq)
+        else:  # legacy overwrite-in-place layout (no checksums)
+            state_path = os.path.join(self.directory, STATE_NPZ)
+            best_path = os.path.join(self.directory, BEST_NPZ)
+        states = _load_npz_checked(
+            state_path, manifest["structure"], checksums.get("state")
+        )
         best_states = None
         if manifest.get("has_best"):
-            with np.load(os.path.join(self.directory, BEST_NPZ)) as npz:
-                best_states = _unflatten_states(npz, manifest["structure"])
+            best_states = _load_npz_checked(
+                best_path, manifest["structure"], checksums.get("best")
+            )
         return DescentCheckpoint(
             grid_index=manifest["grid_index"],
             iteration=manifest["iteration"],
             states=states,
             best_states=best_states,
             best_metric=manifest.get("best_metric"),
+        )
+
+    def load(
+        self, expect_fingerprint: str | None = None
+    ) -> DescentCheckpoint | None:
+        """Load the newest VALID checkpoint.
+
+        Snapshots are tried newest-first; a torn or corrupt one (bad
+        JSON, truncated npz, checksum mismatch) is logged, counted
+        (``recovery.checkpoint_fallback``) and skipped. Returns ``None``
+        when the directory holds no checkpoint at all; raises
+        :class:`CheckpointCorruptError` when checkpoints exist but NONE
+        validates — starting fresh on top of salvageable state must be
+        an operator decision, not a default.
+
+        When ``expect_fingerprint`` is given, a mismatch with the stored
+        fingerprint is a hard error — resuming state trained under
+        different hyperparameters would silently return wrong models.
+        """
+        candidates = self._candidate_manifests()
+        if not candidates:
+            return None
+        failures: list[CheckpointCorruptError] = []
+        for i, mpath in enumerate(candidates):
+            try:
+                manifest = self._load_manifest(mpath)
+                stored = manifest.get("fingerprint")
+                if (
+                    expect_fingerprint is not None
+                    and stored is not None
+                    and stored != expect_fingerprint
+                ):
+                    # a config mismatch is not corruption: every retained
+                    # snapshot shares the fingerprint, so falling back
+                    # cannot help — fail hard with the actionable message
+                    raise ValueError(
+                        "checkpoint was written under a different "
+                        "training configuration; delete the checkpoint "
+                        f"directory ({self.directory}) to start fresh"
+                    )
+                ckpt = self._load_snapshot(manifest)
+            except CheckpointCorruptError as e:
+                failures.append(e)
+                logger.warning(
+                    "checkpoint snapshot invalid, falling back to the "
+                    "previous one: %s", e,
+                )
+                obs.counter("recovery.checkpoint_fallback")
+                obs.instant(
+                    "recovery.checkpoint_fallback",
+                    cat="lifecycle",
+                    path=e.path,
+                    reason=e.reason,
+                )
+                continue
+            if i > 0:
+                logger.warning(
+                    "resumed from fallback snapshot %s (head was corrupt)",
+                    mpath,
+                )
+            return ckpt
+        raise CheckpointCorruptError(
+            failures[0].path,
+            "no valid snapshot in "
+            f"{self.directory} ({len(failures)} tried: "
+            + "; ".join(f.reason for f in failures)
+            + ")",
         )

@@ -732,13 +732,18 @@ class RandomEffectCoordinate(Coordinate):
                 else np.pad(b.score_feats, [(0, m_pad), (0, 0)])
             )
             # placement wrapped against transient relay UNAVAILABLE: one
-            # flaky put must not kill a multi-minute coordinate build
+            # flaky put must not kill a multi-minute coordinate build.
+            # The fault point sits INSIDE the retried thunk, so an
+            # injected UNAVAILABLE exercises the real retry path
+            # (util/faults.py; each retry re-counts the occurrence)
+            from photon_tpu.util import faults
             from photon_tpu.util.device_retry import put_with_retry
 
             device_buckets.append(
                 put_with_retry(
                     lambda b=b, pad_e=pad_e, score_feats=score_feats,
                     score_slot=score_slot, score_pos=score_pos, m_pad=m_pad: (
+                        faults.fault_point("coordinate.placement"),
                         _DeviceBucket(
                             features=put_entities(
                                 jnp.asarray(pad_e(b.features), dtype=dtype)
@@ -768,8 +773,8 @@ class RandomEffectCoordinate(Coordinate):
                             score_pad_slots=int(m_pad),
                             entity_ids=b.entity_ids,
                             col_index=b.col_index,
-                        )
-                    )
+                        ),
+                    )[1]
                 )
             )
         # placement choke point: every bucket's device-resident blocks

@@ -30,7 +30,7 @@ import jax
 from photon_tpu import obs
 from photon_tpu.game.coordinate import Coordinate, sweep_donation_enabled
 from photon_tpu.obs.health import DivergenceError, resolve_policy
-from photon_tpu.util import compile_watch, dispatch_count
+from photon_tpu.util import compile_watch, dispatch_count, faults
 from photon_tpu.util.force import fetch_scalars, force
 from photon_tpu.util.sanitize import sanctioned_transfers, transfer_sanitizer
 
@@ -236,6 +236,24 @@ def _copy_device_leaves(tree):
     return _copy_tree_jit(tree)
 
 
+@jax.jit
+def _poison_tree_jit(tree):
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: (x * jnp.nan).astype(x.dtype), tree
+    )
+
+
+def _poison_state_nan(state):
+    """Chaos-only (util/faults.py ``descent.coordinate`` → ``nan``):
+    overwrite every leaf of a coordinate state with NaN on device — the
+    injected divergence the health monitor must catch at the next sweep
+    boundary. One dispatch, and only on the injection path."""
+    dispatch_count.record(1)
+    return _poison_tree_jit(state)
+
+
 def _read_health(
     health_dev: Mapping[str, dict | None], barrier
 ) -> dict[str, dict]:
@@ -418,6 +436,9 @@ def run_coordinate_descent(
     per_coordinate = tracker_granularity == "coordinate"
     halted: set[str] = set()
     for it in range(start_iteration, num_iterations):
+        # chaos hook (no-op without a fault plan): kill/crash/transient
+        # mid-fit — the auto-resume path's injection site
+        faults.fault_point("descent.sweep")
         d0 = dispatch_count.snapshot()
         c0 = compile_watch.snapshot()
         #: cid → the step's {loss, gnorm, finite} device scalars (None
@@ -434,6 +455,13 @@ def run_coordinate_descent(
                 if cid in halted:
                     continue
                 coord = coordinates[cid]
+                # chaos hook: a matched ``nan`` clause poisons this
+                # coordinate's state BEFORE its step, so the in-program
+                # health fold sees non-finite loss/gnorm at this very
+                # sweep's barrier; raising kinds fire here too
+                _cl = faults.fault_point("descent.coordinate")
+                if _cl is not None and _cl.kind == "nan":
+                    states[cid] = _poison_state_nan(states[cid])
                 with obs.span(
                     "descent.coordinate", iteration=it, coordinate=cid
                 ) as coord_span:

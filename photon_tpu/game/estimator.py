@@ -138,6 +138,17 @@ class GameEstimator:
     #: already-dispatched sweep programs and ride the existing per-sweep
     #: read-back barrier.
     on_divergence: str | None = None
+    #: supervised auto-resume budget (game/recovery.py): when > 0, a
+    #: ``fit`` that fails with a TRANSIENT error (UNAVAILABLE-class
+    #: transport flake, non-permanent I/O) or a DIVERGENT one
+    #: (DivergenceError — the checkpoint predates the poisoned sweep)
+    #: restarts itself up to this many times with capped
+    #: jittered-exponential backoff, resuming from the newest valid
+    #: checkpoint when ``checkpoint_dir`` is set. Fatal errors (shape,
+    #: config, OOM) never retry. ``PHOTON_MAX_RESTARTS`` env wins over
+    #: this value (the env-over-config precedence every knob here
+    #: follows); default 0: supervision off.
+    max_restarts: int | None = None
 
     def __post_init__(self):
         #: per-fit telemetry deltas (wall, dispatches, compiles) for the
@@ -160,6 +171,9 @@ class GameEstimator:
 
         # validate (and env-resolve) at construction, not mid-fit
         self.on_divergence = resolve_policy(self.on_divergence)
+        from photon_tpu.game.recovery import max_restarts_from_env
+
+        self.max_restarts = max_restarts_from_env(self.max_restarts)
 
     # ------------------------------------------------------------------
 
@@ -354,8 +368,8 @@ class GameEstimator:
                     descent_iterations=self.descent_iterations,
                     num_samples=int(data.num_samples),
                 )
-            try:
-                results = self._fit_impl(
+            def attempt():
+                return self._fit_impl(
                     data,
                     validation_data=validation_data,
                     initial_model=initial_model,
@@ -364,6 +378,28 @@ class GameEstimator:
                     checkpoint_every=checkpoint_every,
                     shape_pool=shape_pool,
                 )
+
+            try:
+                if self.max_restarts:
+                    # supervised auto-resume (game/recovery.py): each
+                    # restart re-enters _fit_impl, which reloads the
+                    # newest VALID checkpoint — transient and divergent
+                    # failures resume mid-descent instead of killing the
+                    # training worker; fatal ones re-raise immediately
+                    from photon_tpu.game.recovery import run_with_recovery
+
+                    if checkpoint_dir is None:
+                        logger.warning(
+                            "max_restarts=%d without checkpoint_dir: a "
+                            "restart retrains from scratch instead of "
+                            "resuming mid-descent",
+                            self.max_restarts,
+                        )
+                    results = run_with_recovery(
+                        attempt, max_restarts=self.max_restarts
+                    )
+                else:
+                    results = attempt()
             except Exception as e:
                 # a failed fit must not leave the PREVIOUS fit's numbers
                 # behind as if they described this call

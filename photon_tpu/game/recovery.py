@@ -1,0 +1,195 @@
+"""Supervised auto-resume: classify a failed fit, restart from checkpoint.
+
+The reference gets restart-on-failure for free — a died Spark executor's
+tasks are retried and RDD lineage recomputes lost partitions (SURVEY
+§5.3, spark/RDDLike.scala:26; the executor-retry model is also the
+recovery substrate in "Understanding and Optimizing the Performance of
+Distributed ML Applications on Apache Spark", PAPERS.md). Multi-
+controller JAX has neither, so photon-tpu supervises its own fits: this
+module is the restart loop that composes the recovery ingredients the
+earlier PRs built — bit-exact sweep checkpoints (game/checkpoint.py,
+now with retention + integrity fallback), the shared transient
+classifier (util/retry.py), and the health monitor's divergence signal
+(photon_tpu/obs/health.py).
+
+Failure taxonomy (``classify_failure``):
+
+``transient``
+    The error message carries a transient transport marker
+    (``UNAVAILABLE``/``DEADLINE_EXCEEDED``) or is a non-permanent
+    ``OSError``. Restarting is expected to succeed — the device came
+    back, the file reread works.
+``divergent``
+    :class:`~photon_tpu.obs.health.DivergenceError` — a coordinate went
+    non-finite at a sweep boundary. Restartable BY DEFAULT because the
+    checkpoint predates the poisoned sweep (descent raises before the
+    sweep callback flushes) and descent is deterministic from states: a
+    divergence caused by a transient corruption (bit flip, bad
+    read-back) recovers on replay, while a deterministic one recurs and
+    burns through ``max_restarts`` into the loud failure it deserves.
+``fatal``
+    Everything else — shape errors, config errors, OOM, corrupt-beyond-
+    fallback checkpoints. Never retried: replaying a deterministic bug
+    just multiplies time-to-traceback.
+
+``run_with_recovery`` restarts the supervised callable up to
+``max_restarts`` times with capped jittered-exponential backoff,
+emitting ``recovery.*`` counters and lifecycle events per decision. The
+callable is expected to pick up its own durable progress on re-entry —
+``GameEstimator.fit(checkpoint_dir=...)`` resumes from the newest valid
+snapshot, which is what makes a restart cheap instead of a from-scratch
+retrain.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable
+
+from photon_tpu import obs
+from photon_tpu.obs.health import DivergenceError
+from photon_tpu.util.retry import (
+    RetryPolicy,
+    is_transient,
+    is_transient_io,
+    jitter_rng,
+)
+
+__all__ = [
+    "classify_failure",
+    "max_restarts_from_env",
+    "run_with_recovery",
+]
+
+logger = logging.getLogger(__name__)
+
+#: default restart backoff: quick first retry (most transients clear in
+#: seconds), doubling to a 5-minute cap for a genuinely sick host
+DEFAULT_RESTART_POLICY = RetryPolicy(
+    attempts=1, base_s=2.0, multiplier=2.0, cap_s=300.0, jitter=0.1
+)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """``"transient"`` | ``"divergent"`` | ``"fatal"`` — see module doc."""
+    if isinstance(exc, DivergenceError):
+        return "divergent"
+    if is_transient(exc) or is_transient_io(exc):
+        return "transient"
+    return "fatal"
+
+
+def max_restarts_from_env(value: int | None = None) -> int:
+    """Supervised restart budget: ``PHOTON_MAX_RESTARTS`` env > explicit
+    value > 0 (supervision off)."""
+    env = os.environ.get("PHOTON_MAX_RESTARTS", "").strip()
+    if env:
+        v = int(env)
+    elif value is not None:
+        v = int(value)
+    else:
+        return 0
+    if v < 0:
+        raise ValueError(f"max restarts must be >= 0, got {v}")
+    return v
+
+
+def run_with_recovery(
+    fn: Callable,
+    *,
+    max_restarts: int,
+    classify: Callable[[BaseException], str] = classify_failure,
+    retry_divergent: bool = True,
+    backoff: RetryPolicy = DEFAULT_RESTART_POLICY,
+    label: str = "fit",
+    sleep: Callable[[float], None] = time.sleep,
+    on_restart: Callable[[int, BaseException], None] | None = None,
+):
+    """Run ``fn()`` under restart supervision.
+
+    ``fn`` must be re-entrant over its own durable progress (a
+    checkpointed fit resumes; a stateless callable simply reruns). Up to
+    ``max_restarts`` restarts are spent on failures classified
+    ``transient`` (and ``divergent`` unless ``retry_divergent=False``);
+    ``fatal`` failures and exhausted budgets re-raise the original
+    error. Each decision lands on the obs spine:
+
+    * ``recovery.failures.<kind>`` counter + ``recovery.failure`` event
+      on every classified failure,
+    * ``recovery.restarts`` counter + ``recovery.restart`` event when a
+      restart is granted (``on_restart(restart_index, exc)`` fires too),
+    * ``recovery.giveup`` counter + event when the budget is exhausted.
+    """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts={max_restarts} < 0")
+    restarts = 0
+    while True:
+        try:
+            result = fn()
+        except Exception as e:
+            kind = classify(e)
+            obs.counter(f"recovery.failures.{kind}")
+            obs.instant(
+                "recovery.failure",
+                cat="lifecycle",
+                label=label,
+                kind=kind,
+                error=f"{type(e).__name__}: {e}",
+                restarts_used=restarts,
+            )
+            retryable = kind == "transient" or (
+                kind == "divergent" and retry_divergent
+            )
+            if not retryable:
+                logger.error(
+                    "%s failed with a %s error; not restarting: %s",
+                    label, kind, e,
+                )
+                raise
+            if restarts >= max_restarts:
+                obs.counter("recovery.giveup")
+                obs.instant(
+                    "recovery.giveup",
+                    cat="lifecycle",
+                    label=label,
+                    kind=kind,
+                    restarts_used=restarts,
+                )
+                logger.error(
+                    "%s failed (%s) after exhausting %d restart(s): %s",
+                    label, kind, max_restarts, e,
+                )
+                raise
+            wait = backoff.wait_s(restarts, jitter_rng())
+            restarts += 1
+            obs.counter("recovery.restarts")
+            obs.instant(
+                "recovery.restart",
+                cat="lifecycle",
+                label=label,
+                kind=kind,
+                restart=restarts,
+                wait_s=round(wait, 3),
+                error=f"{type(e).__name__}: {e}",
+            )
+            logger.warning(
+                "%s failed with a %s error; restart %d/%d in %.1fs: %s",
+                label, kind, restarts, max_restarts, wait, e,
+            )
+            if on_restart is not None:
+                on_restart(restarts, e)
+            sleep(wait)
+            continue
+        if restarts:
+            obs.counter("recovery.recovered")
+            obs.instant(
+                "recovery.recovered",
+                cat="lifecycle",
+                label=label,
+                restarts_used=restarts,
+            )
+            logger.info(
+                "%s recovered after %d restart(s)", label, restarts
+            )
+        return result

@@ -69,7 +69,8 @@ from photon_tpu.game.model import (
     MatrixFactorizationModel,
     RandomEffectModel,
 )
-from photon_tpu.util import compile_watch
+from photon_tpu.util import compile_watch, faults
+from photon_tpu.util.retry import RetryPolicy, is_transient, retry_call
 from photon_tpu.util.sanitize import sanctioned_transfers, transfer_sanitizer
 
 logger = logging.getLogger(__name__)
@@ -90,6 +91,51 @@ DEFAULT_DENSE_COLS_MAX = 4096
 #: (current + double-buffered pending), so total live residency is
 #: bounded at MAX_STAGED_CHUNKS + 2 — still a constant.
 MAX_STAGED_CHUNKS = 2
+
+#: default producer-watchdog timeout (seconds): how long the consumer
+#: waits for the NEXT decoded chunk before declaring the producer hung
+#: (``PHOTON_STREAM_WATCHDOG_S`` override; 0 disables). Generous by
+#: design — it must only fire on a genuinely stuck producer, never on a
+#: slow disk
+DEFAULT_WATCHDOG_S = 300.0
+
+#: per-batch transient retry (the "requeue": the decoded chunk is still
+#: on host, so a retry re-stages and re-dispatches the same batch)
+BATCH_RETRY_POLICY = RetryPolicy(attempts=3, base_s=0.5, cap_s=15.0)
+
+
+def stream_watchdog_s(config_value: float | None = None) -> float:
+    """Producer-watchdog seconds: ``PHOTON_STREAM_WATCHDOG_S`` env >
+    explicit value > :data:`DEFAULT_WATCHDOG_S`; 0 disables."""
+    env = os.environ.get("PHOTON_STREAM_WATCHDOG_S", "").strip()
+    if env:
+        v = float(env)  # phl-ok: PHL002 parses an env-var string, not device data
+    elif config_value is not None:
+        # phl-ok: PHL002 parses a config knob (host int/float), not device data
+        v = float(config_value)
+    else:
+        return DEFAULT_WATCHDOG_S
+    if v < 0:
+        raise ValueError(f"stream watchdog must be >= 0, got {v}")
+    return v
+
+
+class StreamError(RuntimeError):
+    """A streaming-pipeline failure the monolithic path does not share —
+    the class the scoring driver's opt-in degrade escape catches."""
+
+
+class ProducerDiedError(StreamError):
+    """The decode producer thread died WITHOUT handing the consumer a
+    sentinel or a failure — abrupt thread death (the chaos
+    ``scoring.producer`` fault). The watchdog converts what would be an
+    eternal ``q.get()`` into this clean error."""
+
+
+class StreamStallError(StreamError):
+    """The producer is alive but produced nothing for the whole watchdog
+    window — a hung decode / slow-host stall. Raised instead of
+    silently wedging the scoring run."""
 
 
 def score_batch_rows(config_value: int | None = None) -> int:
@@ -183,6 +229,9 @@ class StreamStats:
     samples: int = 0
     padded_rows: int = 0
     max_staged_chunks: int = 0
+    #: transient per-batch retries spent (H2D + dispatch re-runs; the
+    #: decoded chunk stays on host, so a retry is a requeue, not a loss)
+    batch_retries: int = 0
     #: per-batch dispatch→read-back walls (batch 0 pays the compiles)
     batch_walls_s: list = dataclasses.field(default_factory=list)
     #: compile_watch delta over the whole stream / over batch 0 only
@@ -249,9 +298,11 @@ class GameScorer:
         batch_rows: int | None = None,
         dense_cols_max: int | None = None,
         donate: bool | None = None,
+        watchdog_s: float | None = None,
     ):
         self.model = model
         self.batch_rows = score_batch_rows(batch_rows)
+        self.watchdog_s = stream_watchdog_s(watchdog_s)
         env_cols = os.environ.get("PHOTON_SCORE_DENSE_COLS", "").strip()
         self.dense_cols_max = (
             int(env_cols)
@@ -576,6 +627,12 @@ class GameScorer:
         consumer's abort signal — every put is bounded by it so a failed
         consumer never leaves this thread blocked on a full queue holding
         decoded chunks."""
+        # chaos hook OUTSIDE the failure-reporting try below: an
+        # injected ``error`` here kills this thread with NO sentinel and
+        # NO _Failure — abrupt thread death, exactly what the consumer's
+        # watchdog must convert into ProducerDiedError; ``stall`` here
+        # models the hung producer the stall watchdog covers
+        faults.fault_point("scoring.producer")
 
         def put(item) -> bool:
             while not stop.is_set():
@@ -589,6 +646,11 @@ class GameScorer:
         try:
             while not stop.is_set():
                 with obs.span("score.decode"):
+                    # chaos hook inside the try: a decode fault reports
+                    # through the normal _Failure hand-off (the source's
+                    # own per-file retries have already been spent by
+                    # the time an error reaches here)
+                    faults.fault_point("scoring.chunk")
                     chunk = next(chunk_iter, _DONE)
                 if chunk is _DONE:
                     put(_DONE)
@@ -602,6 +664,43 @@ class GameScorer:
                     return
         except BaseException as e:  # propagate into the consumer loop
             put(_Failure(e))
+
+    def _next_item(self, q: queue.Queue, producer: threading.Thread):
+        """Watchdog-guarded hand-off read. A healthy producer satisfies
+        the short poll almost always; the slow paths convert the two
+        silent-wedge modes into clean typed errors:
+
+        * producer thread DEAD with an empty queue (it never put its
+          sentinel — abrupt death) → :class:`ProducerDiedError`;
+        * producer alive but silent for the whole watchdog window (hung
+          decode, stalled host) → :class:`StreamStallError`.
+        """
+        waited = 0.0
+        poll = 0.5 if self.watchdog_s == 0 else min(0.5, self.watchdog_s)
+        while True:
+            try:
+                return q.get(timeout=poll)
+            except queue.Empty:
+                pass
+            if not producer.is_alive():
+                try:  # it may have put + exited between timeout and check
+                    return q.get_nowait()
+                except queue.Empty:
+                    obs.counter("score.producer_deaths")
+                    raise ProducerDiedError(
+                        "score-decode producer thread died without "
+                        "reporting a result or an error; the stream "
+                        "cannot make progress"
+                    ) from None
+            waited += poll
+            if self.watchdog_s and waited >= self.watchdog_s:
+                obs.counter("score.stream_stalls")
+                raise StreamStallError(
+                    f"score-decode producer produced nothing for "
+                    f"{waited:.0f}s (watchdog "
+                    f"PHOTON_STREAM_WATCHDOG_S={self.watchdog_s:g}); "
+                    "treating the stream as hung"
+                )
 
     def stream(
         self,
@@ -674,7 +773,7 @@ class GameScorer:
             failure: BaseException | None = None
             try:
                 while True:
-                    item = q.get()
+                    item = self._next_item(q, producer)
                     if isinstance(item, _Failure):
                         failure = item.exc
                         break
@@ -693,19 +792,43 @@ class GameScorer:
                             "score.padded_rows",
                             self.batch_rows - chunk.num_samples,
                         )
-                    with obs.span("score.h2d"), sanctioned_transfers(
-                        "scoring H2D staging — the batch pytree is placed "
-                        "whole, explicitly, once per batch"
-                    ):
-                        # phl-ok: PHL007 single-host scoring engine: the batch is placed on the default device; a mesh-sharded scorer must pass shardings here
-                        batch_dev = jax.device_put(host_batch)
-                        # ingest choke point: the batch's H2D bill (from
-                        # placed-handle metadata — free, gated no-op)
-                        obs.memory.count_h2d(
-                            obs.memory.tree_device_bytes(batch_dev)
-                        )
+
+                    # per-batch retry-with-requeue: the decoded chunk is
+                    # still on host, so a transient H2D/dispatch failure
+                    # re-stages and re-dispatches THIS batch instead of
+                    # killing the stream (util/retry.py classifier:
+                    # non-transient errors propagate on attempt 1)
+                    tries = 0
+
+                    def run_batch(host_batch=host_batch, key=key):
+                        nonlocal tries
+                        tries += 1
+                        # chaos hook: a transient fault here exercises
+                        # the requeue path end to end
+                        faults.fault_point("scoring.batch")
+                        with obs.span("score.h2d"), sanctioned_transfers(
+                            "scoring H2D staging — the batch pytree is "
+                            "placed whole, explicitly, once per batch"
+                        ):
+                            # phl-ok: PHL007 single-host scoring engine: the batch is placed on the default device; a mesh-sharded scorer must pass shardings here
+                            batch_dev = jax.device_put(host_batch)
+                            # ingest choke point: the batch's H2D bill
+                            # (placed-handle metadata — free, gated no-op)
+                            obs.memory.count_h2d(
+                                obs.memory.tree_device_bytes(batch_dev)
+                            )
+                        return self._dispatch(batch_dev, key)
+
                     t_dispatch = time.perf_counter()
-                    dev_scores = self._dispatch(batch_dev, key)
+                    dev_scores = retry_call(
+                        run_batch,
+                        policy=BATCH_RETRY_POLICY,
+                        classify=is_transient,
+                        label="score_batch",
+                    )
+                    if tries > 1:
+                        stats.batch_retries += tries - 1
+                        obs.counter("score.batch_retries", tries - 1)
                     # double buffer: batch i's read-back happens only
                     # after batch i+1 is enqueued, so H2D + host assembly
                     # of the next batch overlap the device compute of

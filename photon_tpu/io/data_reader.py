@@ -24,6 +24,8 @@ from photon_tpu.data.index_map import (
 )
 from photon_tpu.game.data import CSRMatrix, GameData
 from photon_tpu.io.avro import read_avro_dir
+from photon_tpu.util import faults
+from photon_tpu.util.retry import IO_RETRY_POLICY, is_transient_io, retry_call
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,11 +109,23 @@ class AvroDataReader:
         decode loop split out as ``io.decode``), recording records read,
         decoder used, and shard count; ``io.records`` / ``io.bytes``
         counters accumulate volume.
+
+        Resilience: transient I/O failures (a flaky NFS read, an
+        injected ``io.decode`` fault) retry through the shared substrate
+        (util/retry.py — capped jittered-exponential, ``retry.attempts``
+        counter). Reads are idempotent, so a retry re-decodes from the
+        start; permanent errors (missing file, bad schema) propagate
+        immediately.
         """
         if isinstance(paths, (str, bytes)):
             paths = [paths]
         with obs.span("io.read", paths=len(paths)) as read_span:
-            return self._read(paths, shard_configs, id_tags, read_span)
+            return retry_call(
+                lambda: self._read(paths, shard_configs, id_tags, read_span),
+                policy=IO_RETRY_POLICY,
+                classify=is_transient_io,
+                label="avro_read",
+            )
 
     def iter_chunks(
         self,
@@ -183,6 +197,10 @@ class AvroDataReader:
             yield concat_game_data(pending)
 
     def _read(self, paths, shard_configs, id_tags, read_span):
+        # chaos hook (no-op without a fault plan): a decode-level I/O
+        # fault — lands INSIDE the retry above, so an injected transient
+        # exercises the real recovery path
+        faults.fault_point("io.decode")
         if os.environ.get("PHOTON_NO_NATIVE_AVRO") != "1":
             with obs.span("io.decode", decoder="native") as native_span:
                 try:

@@ -621,17 +621,37 @@ class ShardedScoringWriter:
             return concat(present) if present else None
 
         def flush_part(part: int) -> tuple[str, int]:
+            from photon_tpu.util import faults
+            from photon_tpu.util.retry import (
+                IO_RETRY_POLICY,
+                is_transient_io,
+                retry_call,
+            )
+
             s_chunks, l_chunks, w_chunks, u_chunks = self._parts.get(
                 part, ([], [], [], [])
             )
             path = self.out_dir / f"part-{part:05d}.avro"
-            n = save_scoring_results(
-                path,
-                np.concatenate(s_chunks) if s_chunks else np.zeros(0),
-                model_id=self.model_id,
-                labels=col(l_chunks, np.concatenate),
-                weights=col(w_chunks, np.concatenate),
-                uids=col(u_chunks, lambda us: [u for c in us for u in c]),
+
+            def write():
+                # chaos hook (no-op without a fault plan); the flush is
+                # a whole-file rewrite, so a transient retry through the
+                # shared substrate is idempotent
+                faults.fault_point("io.shard_flush")
+                return save_scoring_results(
+                    path,
+                    np.concatenate(s_chunks) if s_chunks else np.zeros(0),
+                    model_id=self.model_id,
+                    labels=col(l_chunks, np.concatenate),
+                    weights=col(w_chunks, np.concatenate),
+                    uids=col(u_chunks, lambda us: [u for c in us for u in c]),
+                )
+
+            n = retry_call(
+                write,
+                policy=IO_RETRY_POLICY,
+                classify=is_transient_io,
+                label="shard_flush",
             )
             return str(path), n
 

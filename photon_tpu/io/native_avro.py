@@ -447,6 +447,12 @@ def read_game_data_native(
         return None
     decoded: list[DecodedFile] = []
     for fp in files:
+        # chaos hook (no-op without a fault plan): a native-decoder
+        # failure must divert to the record-dict fallback with identical
+        # output, never abort the read (tests/test_chaos.py pins parity)
+        from photon_tpu.util import faults
+
+        faults.fault_point("io.native_decode")
         try:
             compiled = compile_program(read_schema(fp), all_bags)
         except (ValueError, KeyError, OSError):

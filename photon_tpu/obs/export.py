@@ -227,14 +227,23 @@ def histogram_summary(registry=None) -> str:
         f"{'histogram':<{width}} {'count':>7} {'mean':>10}{pcols} {'max':>10}"
     ]
     for name, h in rows:
-        mean = h["sum"] / h["count"] if h["count"] else 0.0
+        # non-finite samples count but carry no sum (metrics.py keeps
+        # them out of the streaming moments): the mean averages the
+        # FINITE samples only, and min/max may be None when every
+        # sample was non-finite — a diverged run's summary must render,
+        # not crash the export
+        nonfinite = h.get("nonfinite", 0)
+        finite_n = h["count"] - nonfinite
+        mean = h["sum"] / finite_n if finite_n else 0.0
+        h_max = h["max"] if h["max"] is not None else float("nan")
         pvals = "".join(
             f" {h.get('p' + str(p)) or 0.0:>10.4g}"
             for p in SUMMARY_PERCENTILES
         )
+        suffix = f"  ({nonfinite} non-finite)" if nonfinite else ""
         lines.append(
             f"{name:<{width}} {h['count']:>7} {mean:>10.4g}{pvals} "
-            f"{h['max']:>10.4g}"
+            f"{h_max:>10.4g}{suffix}"
         )
     return "\n".join(lines)
 

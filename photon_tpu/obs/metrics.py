@@ -21,6 +21,7 @@ the way ``compile_watch`` deltas do.
 from __future__ import annotations
 
 import math
+import sys
 import threading
 
 #: log-bucket growth factor: each bucket spans ×1.1 of value range, so a
@@ -34,17 +35,27 @@ SUMMARY_PERCENTILES = (50, 90, 99)
 
 
 def _bucket_index(value: float) -> int:
-    """Sparse log-bucket index; values ≤ 0 share the floor bucket (a
-    latency/bytes histogram never legitimately goes negative)."""
-    if value <= 0:
+    """Sparse log-bucket index; values ≤ 0 (and -inf) share the floor
+    bucket (a latency/bytes histogram never legitimately goes negative)
+    and NaN/+inf the ceiling bucket — a diverged run's non-finite
+    health sample must register as an outlier, not crash the registry
+    with a ValueError that masks the DivergenceError (found by the
+    chaos NaN-injection test)."""
+    if math.isnan(value) or value == math.inf:
+        return 10**6
+    if value <= 0:  # -inf lands here with the other non-positives
         return -(10**6)
     return math.floor(math.log(value) / _LOG_BASE)
 
 
 def _bucket_value(index: int) -> float:
-    """Representative (geometric-midpoint) value of a bucket."""
+    """Representative (geometric-midpoint) value of a bucket. The
+    outlier ceiling reports as float max, not inf — snapshots must stay
+    strict-JSON serializable (json.dump would emit `Infinity`)."""
     if index == -(10**6):
         return 0.0
+    if index == 10**6:
+        return sys.float_info.max
     return _BUCKET_BASE ** (index + 0.5)
 
 
@@ -62,9 +73,14 @@ def percentile_from_buckets(h: dict, q: float) -> float | None:
         seen += buckets[str(idx)] if str(idx) in buckets else buckets[idx]
         if seen >= target:
             # clamp into the observed range: the log-midpoint of the
-            # extreme buckets can overshoot the true min/max
+            # extreme buckets can overshoot the true min/max (min/max
+            # are None when every sample so far was non-finite)
             v = _bucket_value(idx)
-            return min(max(v, h.get("min", v)), h.get("max", v))
+            lo = h.get("min")
+            hi = h.get("max")
+            lo = v if lo is None else lo
+            hi = v if hi is None else hi
+            return min(max(v, lo), hi)
     return h.get("max")
 
 
@@ -92,17 +108,31 @@ class MetricsRegistry:
         with self._lock:
             h = self._hists.get(name)
             if h is None:
+                # min/max seed from the first FINITE sample (a NaN
+                # first sample must not stick as the range forever)
                 h = self._hists[name] = {
                     "count": 0,
                     "sum": 0.0,
-                    "min": value,
-                    "max": value,
+                    "min": None,
+                    "max": None,
                     "buckets": {},
                 }
             h["count"] += 1
-            h["sum"] += value
-            h["min"] = min(h["min"], value)
-            h["max"] = max(h["max"], value)
+            if math.isfinite(value):
+                h["sum"] += value
+                h["min"] = (
+                    value if h["min"] is None else min(h["min"], value)
+                )
+                h["max"] = (
+                    value if h["max"] is None else max(h["max"], value)
+                )
+            else:
+                # a non-finite sample counts (it lands in an outlier
+                # bucket below) but must not poison the streaming
+                # moments for the rest of the run — one NaN would make
+                # sum/mean NaN forever and the exported snapshot
+                # non-strict JSON
+                h["nonfinite"] = h.get("nonfinite", 0) + 1
             # string keys: the snapshot must round-trip through JSON
             # without the int→str key coercion changing its shape
             b = str(_bucket_index(value))

@@ -90,9 +90,15 @@ def shard_windows(
     inst_sharded = NamedSharding(mesh, P(axes))
     inst_mat = NamedSharding(mesh, P(axes, None))
     # placement wrapped against transient relay UNAVAILABLE, like every
-    # other multi-hundred-MB coordinate-build put (game/coordinate.py)
+    # other multi-hundred-MB coordinate-build put (game/coordinate.py);
+    # the chaos fault point rides inside the retried thunk
+    from photon_tpu.util import faults
+
     put = lambda x, s: put_with_retry(  # noqa: E731
-        lambda x=x, s=s: jax.device_put(x, s)
+        lambda x=x, s=s: (
+            faults.fault_point("sparse.placement"),
+            jax.device_put(x, s),
+        )[1]
     )
     return ColumnWindows(
         rows=put(windows.rows, inst_mat),

@@ -10,41 +10,31 @@ class of failure to Spark task retry (SURVEY §5.3,
 spark/RDDLike.scala:26); this helper is the placement-granular TPU
 analogue.
 
-Only errors whose message matches a transient pattern are retried;
-everything else (shape errors, OOM, ...) propagates immediately.
+Since PR 10 this is a thin wrapper over the shared retry substrate
+(util/retry.py — capped jittered exponential, ``retry.attempts``
+telemetry, the transient-only classifier). Only errors whose message
+matches a transient pattern are retried; everything else (shape errors,
+OOM, ...) propagates immediately.
 """
 from __future__ import annotations
 
-import logging
-import time
-
-_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Unavailable")
-_logger = logging.getLogger(__name__)
+from photon_tpu.util.retry import RetryPolicy, is_transient, retry_call
 
 
 def put_with_retry(fn, *, attempts: int = 3, backoff_s: float = 20.0):
-    """Run ``fn()`` (a placement thunk returning device array(s)), retrying
-    transient device errors with linear backoff. Returns fn's result."""
-    if attempts < 1:
-        raise ValueError(f"attempts={attempts} < 1")
-    last = None
-    for attempt in range(attempts):
-        try:
-            return fn()
-        except Exception as e:  # jax.errors.JaxRuntimeError et al.
-            msg = str(e)
-            if not any(m in msg for m in _TRANSIENT_MARKERS):
-                raise
-            last = e
-            if attempt + 1 < attempts:
-                wait = backoff_s * (attempt + 1)
-                _logger.warning(
-                    "transient device placement error (attempt %d/%d), "
-                    "retrying in %.0fs: %s",
-                    attempt + 1,
-                    attempts,
-                    wait,
-                    msg.splitlines()[0][:200],
-                )
-                time.sleep(wait)
-    raise last
+    """Run ``fn()`` (a placement thunk returning device array(s)),
+    retrying transient device errors. Returns fn's result.
+
+    ``backoff_s`` seeds the exponential schedule's base (the historical
+    linear schedule's first wait), doubling per retry up to a 2-minute
+    cap with ±10% jitter.
+    """
+    return retry_call(
+        fn,
+        policy=RetryPolicy(
+            attempts=attempts, base_s=backoff_s, multiplier=2.0,
+            cap_s=120.0, jitter=0.1,
+        ),
+        classify=is_transient,
+        label="device_put",
+    )

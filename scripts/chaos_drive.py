@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""End-to-end chaos drive: train → kill → auto-resume → stream-score.
+
+The CI `chaos` job's workload (and a by-hand triage tool): runs the real
+CLI drivers as subprocesses under standing fault plans (PHOTON_FAULTS,
+util/faults.py) and asserts exit 0 + MODEL/SCORE PARITY against the
+no-fault legs:
+
+  leg A  transient UNAVAILABLE on the first coordinate-build placement —
+         the shared retry substrate absorbs it inside one process; the
+         trained model must be bit-exact vs baseline.
+  leg B  SIGKILL mid-fit (descent.sweep@2=kill) on a checkpointed run,
+         then a RELAUNCH of the same command with faults cleared — the
+         acceptance scenario: resume from the newest valid checkpoint,
+         model hash equal to the uninterrupted run's.
+  leg C  producer-thread death mid-stream with the opt-in degrade
+         escape (PHOTON_SCORE_DEGRADE=1) — the scoring driver must
+         complete monolithically with scores matching the clean run.
+
+Usage: python scripts/chaos_drive.py [--workdir DIR] [--n 400]
+Exit 0 = every leg green; non-zero with a named failure otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_USERS = 8
+D_FIXED = 6
+SHARD_ARG = "name=global,feature.bags=features"
+
+
+def make_records(seed=0, n=400):
+    rng_w = np.random.default_rng(42)
+    w_global = rng_w.normal(size=D_FIXED)
+    w_user = rng_w.normal(size=(N_USERS, D_FIXED)) * 2.0
+    rng = np.random.default_rng(seed)
+    records = []
+    for i in range(n):
+        u = int(rng.integers(N_USERS))
+        x = rng.normal(size=D_FIXED)
+        margin = x @ (w_global + w_user[u])
+        y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+        records.append(
+            {
+                "uid": f"s{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(D_FIXED)
+                ],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+        )
+    return records
+
+
+def write_data(root: str, n: int) -> None:
+    from photon_tpu.io.avro import write_avro_file
+    from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+    for split, seed, rows in (("train", 0, n), ("score", 1, n // 2)):
+        d = os.path.join(root, split)
+        os.makedirs(d, exist_ok=True)
+        write_avro_file(
+            os.path.join(d, "part-00000.avro"),
+            TRAINING_EXAMPLE_AVRO,
+            make_records(seed, rows),
+        )
+
+
+def run_cli(module, args, *, env=None, expect_rc=0, label=""):
+    """Run a driver subprocess; returns the CompletedProcess. ``expect_rc``
+    of None skips the return-code assertion (the SIGKILL leg)."""
+    full_env = dict(os.environ)
+    full_env.pop("PHOTON_FAULTS", None)  # each leg sets its own plan
+    full_env.setdefault("JAX_PLATFORMS", "cpu")
+    full_env.update(env or {})
+    cmd = [sys.executable, "-m", module, *args]
+    print(f"[chaos] {label}: {' '.join(cmd)}")
+    if env:
+        print(f"[chaos]   env: {env}")
+    proc = subprocess.run(
+        cmd, cwd=REPO, env=full_env, capture_output=True, text=True,
+        timeout=1200,
+    )
+    if expect_rc is not None and proc.returncode != expect_rc:
+        print(proc.stdout[-4000:])
+        print(proc.stderr[-4000:])
+        raise SystemExit(
+            f"[chaos] {label}: expected rc={expect_rc}, got "
+            f"{proc.returncode}"
+        )
+    return proc
+
+
+def training_args(data_root, out_root, *, checkpoint=False, restarts=None):
+    args = [
+        "--input-data-directories", os.path.join(data_root, "train"),
+        "--root-output-directory", out_root,
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configurations", SHARD_ARG,
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=20,"
+        "regularization=L2,reg.weights=1",
+        "--coordinate-configurations",
+        "name=per-user,random.effect.type=userId,feature.shard=global,"
+        "max.iter=10,regularization=L2,reg.weights=1",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-descent-iterations", "3",
+    ]
+    if checkpoint:
+        args += ["--checkpoint-sweeps", "--output-mode", "ALL"]
+    if restarts is not None:
+        args += ["--max-restarts", str(restarts)]
+    return args
+
+
+def model_hash(model_dir: str) -> str:
+    """Order-stable sha256 over every coefficient array of a saved GAME
+    model — the parity oracle (avro container bytes are NOT comparable:
+    sync markers are random)."""
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        MatrixFactorizationModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.model_io import load_game_model, read_model_feature_keys
+
+    shard_configs = {
+        "global": FeatureShardConfig(feature_bags=("features",))
+    }
+    maps = read_model_feature_keys(model_dir, shard_configs)
+    model = load_game_model(model_dir, maps)
+    h = hashlib.sha256()
+    for cid in sorted(model.coordinates):
+        cm = model.coordinates[cid]
+        h.update(cid.encode())
+        if isinstance(cm, FixedEffectModel):
+            h.update(np.ascontiguousarray(cm.model.coefficients.means).tobytes())
+        elif isinstance(cm, RandomEffectModel):
+            for b in cm.buckets:
+                h.update(np.ascontiguousarray(b.entity_ids).tobytes())
+                h.update(np.ascontiguousarray(b.coefficients).tobytes())
+        elif isinstance(cm, MatrixFactorizationModel):
+            h.update(np.ascontiguousarray(cm.row_factors).tobytes())
+            h.update(np.ascontiguousarray(cm.col_factors).tobytes())
+    return h.hexdigest()
+
+
+def scores_by_uid(scores_dir: str) -> dict:
+    from photon_tpu.io.avro import read_avro_file
+
+    out = {}
+    for name in sorted(os.listdir(scores_dir)):
+        if not name.endswith(".avro"):
+            continue
+        for r in read_avro_file(os.path.join(scores_dir, name)):
+            out[r["uid"]] = r["predictionScore"]
+    return out
+
+
+def scoring_args(data_root, out_root, model_dir):
+    return [
+        "--input-data-directories", os.path.join(data_root, "score"),
+        "--root-output-directory", out_root,
+        "--feature-shard-configurations", SHARD_ARG,
+        "--model-input-directory", model_dir,
+        "--score-batch-rows", "64",
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--n", type=int, default=400)
+    args = ap.parse_args()
+
+    work = args.workdir or tempfile.mkdtemp(prefix="photon-chaos-")
+    os.makedirs(work, exist_ok=True)
+    data_root = os.path.join(work, "data")
+    write_data(data_root, args.n)
+    print(f"[chaos] workspace: {work}")
+
+    train_mod = "photon_tpu.cli.game_training"
+    score_mod = "photon_tpu.cli.game_scoring"
+
+    # -- baseline: the uninterrupted run every leg is compared against --
+    base_out = os.path.join(work, "baseline")
+    run_cli(train_mod, training_args(data_root, base_out), label="baseline")
+    base_hash = model_hash(os.path.join(base_out, "best"))
+    print(f"[chaos] baseline model hash {base_hash[:16]}…")
+
+    # -- leg A: transient UNAVAILABLE mid coordinate build -------------
+    a_out = os.path.join(work, "legA")
+    run_cli(
+        train_mod,
+        training_args(data_root, a_out, restarts=2),
+        env={"PHOTON_FAULTS": "coordinate.placement@1=unavailable"},
+        label="legA transient-placement",
+    )
+    a_hash = model_hash(os.path.join(a_out, "best"))
+    if a_hash != base_hash:
+        raise SystemExit(
+            f"[chaos] legA PARITY FAIL: {a_hash[:16]}… != {base_hash[:16]}…"
+        )
+    print("[chaos] legA ok: placement flake absorbed, model bit-exact")
+
+    # -- leg B: SIGKILL mid-fit, relaunch resumes from checkpoint ------
+    b_out = os.path.join(work, "legB")
+    proc = run_cli(
+        train_mod,
+        training_args(data_root, b_out, checkpoint=True),
+        env={"PHOTON_FAULTS": "descent.sweep@2=kill"},
+        expect_rc=None,
+        label="legB kill",
+    )
+    if proc.returncode == 0:
+        raise SystemExit("[chaos] legB: the SIGKILL plan did not fire")
+    print(f"[chaos] legB killed as planned (rc={proc.returncode}); relaunching")
+    ckpt_manifest = os.path.join(b_out, "checkpoints", "descent-checkpoint.json")
+    if not os.path.exists(ckpt_manifest):
+        raise SystemExit("[chaos] legB: no checkpoint survived the kill")
+    run_cli(
+        train_mod,
+        training_args(data_root, b_out, checkpoint=True),
+        label="legB resume",
+    )
+    b_hash = model_hash(os.path.join(b_out, "best"))
+    if b_hash != base_hash:
+        raise SystemExit(
+            f"[chaos] legB PARITY FAIL: {b_hash[:16]}… != {base_hash[:16]}…"
+        )
+    print("[chaos] legB ok: SIGKILL → relaunch resumed, model bit-exact")
+
+    # -- leg C: producer death mid-stream, degrade escape --------------
+    clean_out = os.path.join(work, "score-clean")
+    run_cli(
+        score_mod,
+        scoring_args(data_root, clean_out, os.path.join(base_out, "best")),
+        label="legC clean score",
+    )
+    c_out = os.path.join(work, "score-chaos")
+    run_cli(
+        score_mod,
+        scoring_args(data_root, c_out, os.path.join(base_out, "best")),
+        env={
+            "PHOTON_FAULTS": "scoring.producer@1=error",
+            "PHOTON_SCORE_DEGRADE": "1",
+            "PHOTON_STREAM_WATCHDOG_S": "30",
+        },
+        label="legC producer-death",
+    )
+    summary = json.load(open(os.path.join(c_out, "scoring-summary.json")))
+    if summary["scoring"]["mode"] != "monolithic":
+        raise SystemExit(
+            f"[chaos] legC: expected degrade to monolithic, got "
+            f"{summary['scoring']['mode']}"
+        )
+    clean_scores = scores_by_uid(os.path.join(clean_out, "scores"))
+    chaos_scores = scores_by_uid(os.path.join(c_out, "scores"))
+    if set(clean_scores) != set(chaos_scores):
+        raise SystemExit("[chaos] legC: score row sets differ")
+    worst = max(
+        abs(clean_scores[u] - chaos_scores[u]) for u in clean_scores
+    )
+    if worst > 1e-5:
+        raise SystemExit(f"[chaos] legC PARITY FAIL: max |Δscore| {worst}")
+    print(
+        f"[chaos] legC ok: degraded to monolithic, {len(chaos_scores)} "
+        f"scores, max |Δ| {worst:.2e}"
+    )
+
+    if args.workdir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    print("[chaos] ALL LEGS GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

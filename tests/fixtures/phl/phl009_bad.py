@@ -1,0 +1,31 @@
+"""PHL009 positive: the two retry-discipline violations, minimized.
+
+The shapes the PR 10 classifier contract (util/retry.py) forbids in hot
+paths: an uncapped `while True` retry, and a bounded loop whose broad
+handler swallows non-transient errors.
+"""
+import time
+
+
+def fetch_forever(fn):
+    # BAD: while True + broad except with no re-raise — no attempt cap;
+    # a shape error retries until the heat death of the universe
+    while True:
+        try:
+            return fn()
+        except Exception:
+            time.sleep(1.0)
+            continue
+
+
+def fetch_swallowing(fn, attempts=3):
+    # BAD: capped, but the broad handler never re-raises and never
+    # consults a transient classifier — an OOM retries like a flake
+    result = None
+    for _ in range(attempts):
+        try:
+            result = fn()
+            break
+        except Exception:
+            time.sleep(1.0)
+    return result

@@ -1,0 +1,37 @@
+"""PHL009 negative: the sanctioned retry shapes.
+
+Capped loops that re-raise on a classifier miss (the put_with_retry
+shape), the shared substrate itself, and narrow handlers.
+"""
+import queue
+import time
+
+from photon_tpu.util.retry import RetryPolicy, is_transient, retry_call
+
+
+def fetch_shared(fn):
+    # GOOD: the shared substrate — capped, classified, counted
+    return retry_call(fn, policy=RetryPolicy(attempts=3))
+
+
+def fetch_hand_rolled(fn, attempts=3):
+    # GOOD: attempt cap + immediate re-raise of non-transient errors
+    last = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            if not is_transient(e):
+                raise
+            last = e
+            time.sleep(float(attempt))
+    raise last
+
+
+def drain(q, stop):
+    # GOOD: a narrow handler in a loop is flow control, not a retry
+    while not stop.is_set():
+        try:
+            return q.get(timeout=0.05)
+        except queue.Empty:
+            continue

@@ -1,0 +1,840 @@
+"""Chaos matrix: deterministic fault injection → recovery → bit-exact.
+
+The proof obligation of the fault-tolerance layer (util/faults.py,
+game/recovery.py, the durable checkpoints, the streaming watchdog): for
+every shipped fault point, inject the fault, let the shipped recovery
+path run, and assert the final result is BIT-EXACT against the no-fault
+run — plus the zero-overhead pin: with no fault plan installed, the
+instrumentation must not change the run's device profile (the same
+dispatch/read-back A/B discipline as obs and the transfer sanitizer).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_tpu import obs
+from photon_tpu.evaluation.evaluators import EvaluatorType
+from photon_tpu.game.checkpoint import (
+    CheckpointCorruptError,
+    DescentCheckpointer,
+)
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator
+from photon_tpu.game.recovery import classify_failure, run_with_recovery
+from photon_tpu.game.scoring import (
+    GameScorer,
+    ProducerDiedError,
+    StreamStallError,
+)
+from photon_tpu.game.model import FixedEffectModel, GameModel
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.glm import model_for_task
+from photon_tpu.obs.health import DivergenceError
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.types import TaskType
+from photon_tpu.util import faults
+from photon_tpu.util.faults import (
+    InjectedCrash,
+    InjectedFault,
+    InjectedIOError,
+    parse_plan,
+)
+from photon_tpu.util.retry import RetryPolicy, retry_call
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    """No test may leak a fault plan into the next."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# fixtures (the test_checkpoint GLMix shape, kept small)
+# ---------------------------------------------------------------------------
+
+
+def _game_data(n=300, d_fe=8, d_re=4, users=15, seed=0):
+    rng = np.random.default_rng(seed)
+    x_fe = rng.normal(size=(n, d_fe))
+    x_re = rng.normal(size=(n, d_re))
+    uid = np.concatenate(
+        [np.arange(users), rng.integers(0, users, size=n - users)]
+    )
+    y = (rng.uniform(size=n) > 0.5).astype(np.float64)
+    return GameData.build(
+        labels=y,
+        feature_shards={
+            "fe": CSRMatrix.from_dense(x_fe),
+            "re": CSRMatrix.from_dense(x_re),
+        },
+        id_tags={"userId": uid},
+    )
+
+
+def _estimator(grid=(1.0,), iters=3, **kw):
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        regularization=RegularizationContext(
+            regularization_type=RegularizationType.L2
+        ),
+        optimizer_config=OptimizerConfig(
+            max_iterations=4, ls_max_iterations=4
+        ),
+    )
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="fe",
+                optimization=opt,
+                regularization_weights=grid,
+            ),
+            "per-user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="re",
+                optimization=opt,
+                regularization_weights=grid,
+            ),
+        },
+        update_sequence=["fixed", "per-user"],
+        descent_iterations=iters,
+        dtype=jnp.float32,
+        **kw,
+    )
+
+
+def _model_arrays(model):
+    out = {"fixed": np.asarray(model["fixed"].model.coefficients.means)}
+    re = model["per-user"]
+    for b, bucket in enumerate(re.buckets):
+        out[f"re/{b}"] = np.asarray(bucket.coefficients)
+    return out
+
+
+def _assert_models_identical(a, b):
+    arrays_a, arrays_b = _model_arrays(a), _model_arrays(b)
+    assert arrays_a.keys() == arrays_b.keys()
+    for k in arrays_a:
+        np.testing.assert_array_equal(arrays_a[k], arrays_b[k], err_msg=k)
+
+
+def _counters():
+    return obs.get_registry().snapshot().get("counters", {})
+
+
+# ---------------------------------------------------------------------------
+# fault plan parsing + zero-overhead pin
+# ---------------------------------------------------------------------------
+
+
+def test_plan_parse_round_trip():
+    plan = parse_plan(
+        "io.decode@2=io_error; descent.sweep@*=stall:0.5;"
+        "coordinate.placement@1=unavailable"
+    )
+    assert [c.render() for c in plan.clauses] == [
+        "io.decode@2=io_error",
+        "descent.sweep@*=stall:0.5",
+        "coordinate.placement@1=unavailable",
+    ]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",                        # no clauses
+        "io.decode=io_error",      # missing @occurrence
+        "io.decode@0=io_error",    # occurrence is 1-based
+        "io.decode@1=explode",     # unknown kind
+        "io.decode@1",             # no action
+    ],
+)
+def test_plan_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_plan(bad)
+
+
+def test_occurrence_matching_is_deterministic():
+    with faults.injected("p@2=io_error"):
+        assert faults.fault_point("p") is None          # occurrence 1
+        with pytest.raises(InjectedIOError):
+            faults.fault_point("p")                     # occurrence 2
+        assert faults.fault_point("p") is None          # occurrence 3
+        assert faults.fault_point("other") is None      # unplanned point
+
+
+def test_faults_disabled_is_dispatch_and_readback_neutral(monkeypatch):
+    """Acceptance: the fault-point instrumentation, with no plan (and
+    with a plan naming only nonexistent points), must not change the
+    run's device profile — same tracked dispatches per sweep, same
+    read-back count. Mirror of the obs/PR 4 A/B."""
+    import photon_tpu.game.descent as descent_mod
+
+    forces = {"n": 0}
+    real_force = descent_mod.force
+    real_fetch = descent_mod.fetch_scalars
+
+    def counting_force(*a, **kw):
+        forces["n"] += 1
+        return real_force(*a, **kw)
+
+    def counting_fetch(*a, **kw):
+        forces["n"] += 1
+        return real_fetch(*a, **kw)
+
+    monkeypatch.setattr(descent_mod, "force", counting_force)
+    monkeypatch.setattr(descent_mod, "fetch_scalars", counting_fetch)
+
+    def run(plan):
+        faults.clear()
+        if plan:
+            faults.install(plan)
+        data = _game_data(seed=11)
+        forces["n"] = 0
+        result = _estimator(iters=2).fit(data)[0]
+        rows = [
+            r["dispatches"] for r in result.tracker if "sweep_seconds" in r
+        ]
+        return rows, forces["n"]
+
+    rows_off, forces_off = run(None)
+    rows_armed, forces_armed = run("no.such.point@1=error")
+    assert rows_armed == rows_off
+    assert forces_armed == forces_off
+    assert len(rows_off) == 2 and all(d >= 1 for d in rows_off)
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: fit-side faults → recover → bit-exact
+# ---------------------------------------------------------------------------
+
+
+def test_transient_placement_fault_recovers_bit_exact():
+    """coordinate.placement → UNAVAILABLE on the first bucket placement:
+    put_with_retry (now the shared substrate) must absorb it and the fit
+    must match the no-fault run bit for bit."""
+    data = _game_data(seed=1)
+    baseline = _estimator().fit(data)[0]
+
+    obs.enable()
+    obs.reset()
+    try:
+        with faults.injected("coordinate.placement@1=unavailable"):
+            res = _estimator().fit(data)[0]
+        counters = _counters()
+        assert counters.get("retry.attempts.device_put", 0) >= 1
+    finally:
+        obs.disable()
+        obs.reset()
+    _assert_models_identical(baseline.model, res.model)
+
+
+def test_placement_fatal_fault_is_not_retried():
+    data = _game_data(seed=1)
+    with faults.injected("coordinate.placement@1=error"):
+        with pytest.raises(InjectedFault, match="injected fatal"):
+            _estimator().fit(data)
+
+
+def test_sweep_transient_fault_auto_resumes_bit_exact(tmp_path):
+    """descent.sweep → UNAVAILABLE at sweep 2: the supervised fit
+    restarts, reloads the newest checkpoint, resumes at the killed
+    sweep, and the final model is bit-exact vs the uninterrupted run."""
+    data = _game_data(seed=2)
+    baseline = _estimator().fit(data)[0]
+
+    obs.enable()
+    obs.reset()
+    try:
+        with faults.injected("descent.sweep@2=unavailable"):
+            res = _estimator(max_restarts=1).fit(
+                data, checkpoint_dir=str(tmp_path / "ckpt")
+            )[0]
+        counters = _counters()
+        assert counters.get("recovery.restarts") == 1
+        assert counters.get("recovery.failures.transient") == 1
+        assert counters.get("recovery.recovered") == 1
+    finally:
+        obs.disable()
+        obs.reset()
+    _assert_models_identical(baseline.model, res.model)
+
+
+def test_sweep_fault_without_restart_budget_raises(tmp_path):
+    data = _game_data(seed=2)
+    with faults.injected("descent.sweep@2=unavailable"):
+        with pytest.raises(InjectedFault, match="UNAVAILABLE"):
+            _estimator().fit(data, checkpoint_dir=str(tmp_path / "c"))
+
+
+def test_nan_injection_diverges_then_auto_resumes_bit_exact(tmp_path):
+    """descent.coordinate → NaN into a sweep: the health monitor raises
+    DivergenceError BEFORE the poisoned state reaches the checkpoint,
+    the supervisor classifies it divergent and restarts, and the resume
+    re-runs the poisoned sweep cleanly — final model bit-exact."""
+    data = _game_data(seed=3)
+    baseline = _estimator().fit(data)[0]
+
+    obs.enable()
+    obs.reset()
+    try:
+        # occurrence 3 = sweep 1, coordinate "fixed" (2 coordinates/sweep)
+        with faults.injected("descent.coordinate@3=nan"):
+            res = _estimator(max_restarts=1).fit(
+                data, checkpoint_dir=str(tmp_path / "ckpt")
+            )[0]
+        counters = _counters()
+        assert counters.get("recovery.failures.divergent") == 1
+        assert counters.get("recovery.restarts") == 1
+        assert counters.get("health.divergence") == 1
+    finally:
+        obs.disable()
+        obs.reset()
+    _assert_models_identical(baseline.model, res.model)
+
+
+def test_nan_injection_without_supervision_raises_divergence():
+    data = _game_data(seed=3)
+    with faults.injected("descent.coordinate@3=nan"):
+        with pytest.raises(DivergenceError):
+            _estimator().fit(data)
+
+
+def test_crash_mid_checkpoint_write_leaves_previous_loadable(tmp_path):
+    """Satellite pin: a crash BETWEEN the tmp-file write and os.replace
+    (the checkpoint.replace fault point) leaves the previous checkpoint
+    loadable, and the resumed fit is bit-exact vs the uninterrupted
+    run."""
+    data = _game_data(seed=4)
+    baseline = _estimator().fit(data)[0]
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    # no validation → exactly one npz per save: occurrence 2 is sweep 1's
+    # state write, dying after the tmp write, before the rename
+    with faults.injected("checkpoint.replace@2=crash"):
+        with pytest.raises(InjectedCrash):
+            _estimator().fit(data, checkpoint_dir=ckpt_dir)
+
+    ckpt = DescentCheckpointer(ckpt_dir).load()
+    assert (ckpt.grid_index, ckpt.iteration) == (0, 0)  # sweep 0 survives
+
+    res = _estimator().fit(data, checkpoint_dir=ckpt_dir)[0]
+    _assert_models_identical(baseline.model, res.model)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability: retention, checksums, fallback
+# ---------------------------------------------------------------------------
+
+
+def _states(i):
+    return {
+        "fixed": np.full(5, float(i)),
+        "per-user": [np.full((3, 2), float(i)), np.ones(2) * i],
+    }
+
+
+def test_retention_keeps_last_k_snapshots(tmp_path):
+    ck = DescentCheckpointer(str(tmp_path), keep=2)
+    for i in range(5):
+        ck.save(0, i, _states(i), None, None, fingerprint="fp")
+    seqs = ck._existing_seqs()
+    assert seqs == [3, 4]  # pruned to the last 2
+    loaded = ck.load(expect_fingerprint="fp")
+    assert loaded.iteration == 4
+    np.testing.assert_array_equal(loaded.states["fixed"], _states(4)["fixed"])
+
+
+def test_checkpoint_keep_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv("PHOTON_CHECKPOINT_KEEP", "4")
+    ck = DescentCheckpointer(str(tmp_path))
+    assert ck.keep == 4
+    monkeypatch.setenv("PHOTON_CHECKPOINT_KEEP", "0")
+    with pytest.raises(ValueError):
+        DescentCheckpointer(str(tmp_path / "x"))
+
+
+def test_corrupt_head_falls_back_to_previous_snapshot(tmp_path):
+    ck = DescentCheckpointer(str(tmp_path), keep=3)
+    for i in range(3):
+        ck.save(0, i, _states(i), None, None)
+    # tear the newest state file: truncate to half
+    newest = ck._state_path(2)
+    raw = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+
+    obs.enable()
+    obs.reset()
+    try:
+        loaded = DescentCheckpointer(str(tmp_path)).load()
+        assert loaded.iteration == 1  # fell back one snapshot
+        assert _counters().get("recovery.checkpoint_fallback", 0) >= 1
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_checksum_mismatch_is_corruption(tmp_path):
+    ck = DescentCheckpointer(str(tmp_path), keep=2)
+    ck.save(0, 0, _states(0), None, None)
+    ck.save(0, 1, _states(1), None, None)
+    # flip bytes mid-file without truncating: only the checksum catches it
+    newest = ck._state_path(1)
+    raw = bytearray(open(newest, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(newest, "wb") as f:
+        f.write(bytes(raw))
+    loaded = DescentCheckpointer(str(tmp_path)).load()
+    assert loaded.iteration == 0
+
+
+def test_all_snapshots_corrupt_raises_typed_error(tmp_path):
+    """Satellite pin: a truncated/corrupt checkpoint surfaces a typed
+    CheckpointCorruptError naming the file — never a raw numpy/zipfile
+    traceback, never a silent fresh start."""
+    ck = DescentCheckpointer(str(tmp_path), keep=2)
+    ck.save(0, 0, _states(0), None, None)
+    path = ck._state_path(0)
+    with open(path, "wb") as f:
+        f.write(b"not an npz")
+    with pytest.raises(CheckpointCorruptError) as ei:
+        DescentCheckpointer(str(tmp_path)).load()
+    assert "descent-state-00000000.npz" in str(ei.value)
+    assert ei.value.path
+
+
+def test_stray_tmp_files_do_not_confuse_load(tmp_path):
+    ck = DescentCheckpointer(str(tmp_path))
+    ck.save(0, 0, _states(0), None, None)
+    # a SIGKILLed writer leaves tmp droppings behind
+    (tmp_path / "zzz-leftover.tmp").write_bytes(b"\x00" * 64)
+    loaded = DescentCheckpointer(str(tmp_path)).load()
+    assert loaded.iteration == 0
+
+
+def test_legacy_overwrite_layout_still_loads(tmp_path):
+    """Pre-retention checkpoint dirs (one manifest + descent-state.npz,
+    no seq, no checksums) must keep resuming."""
+    from photon_tpu.game.checkpoint import (
+        MANIFEST,
+        STATE_NPZ,
+        _flatten_states,
+        _structure_of,
+    )
+
+    states = _states(7)
+    np.savez(str(tmp_path / STATE_NPZ), **_flatten_states(states))
+    (tmp_path / MANIFEST).write_text(
+        json.dumps(
+            {
+                "grid_index": 1,
+                "iteration": 2,
+                "best_metric": None,
+                "has_best": False,
+                "structure": _structure_of(states),
+                "fingerprint": "fp",
+            }
+        )
+    )
+    loaded = DescentCheckpointer(str(tmp_path)).load(expect_fingerprint="fp")
+    assert (loaded.grid_index, loaded.iteration) == (1, 2)
+    np.testing.assert_array_equal(loaded.states["fixed"], states["fixed"])
+
+
+def test_fingerprint_mismatch_is_hard_error_not_fallback(tmp_path):
+    ck = DescentCheckpointer(str(tmp_path))
+    ck.save(0, 0, _states(0), None, None, fingerprint="fp-a")
+    with pytest.raises(ValueError, match="different training"):
+        DescentCheckpointer(str(tmp_path)).load(expect_fingerprint="fp-b")
+
+
+def test_resumed_run_does_not_overwrite_loaded_snapshot(tmp_path):
+    ck = DescentCheckpointer(str(tmp_path), keep=2)
+    ck.save(0, 0, _states(0), None, None)
+    ck2 = DescentCheckpointer(str(tmp_path), keep=2)  # a relaunched run
+    ck2.save(0, 1, _states(1), None, None)
+    # seq continued: both snapshots exist, newest wins
+    assert ck2._existing_seqs() == [0, 1]
+    assert DescentCheckpointer(str(tmp_path)).load().iteration == 1
+
+
+# ---------------------------------------------------------------------------
+# io-side faults
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def avro_dir(tmp_path_factory):
+    from photon_tpu.io.avro import write_avro_file
+    from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+    rng = np.random.default_rng(5)
+    records = []
+    for i in range(120):
+        x = rng.normal(size=4)
+        records.append(
+            {
+                "uid": f"s{i}",
+                "label": float(rng.uniform() > 0.5),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(4)
+                ],
+                "metadataMap": {"userId": f"u{int(rng.integers(6))}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+        )
+    root = tmp_path_factory.mktemp("chaos-avro")
+    write_avro_file(
+        root / "part-00000.avro", TRAINING_EXAMPLE_AVRO, records
+    )
+    return root
+
+
+def _read(avro_dir, **kw):
+    from photon_tpu.io.data_reader import AvroDataReader, FeatureShardConfig
+
+    reader = AvroDataReader(**kw)
+    data = reader.read(
+        str(avro_dir),
+        {"g": FeatureShardConfig(feature_bags=("features",))},
+        id_tags=("userId",),
+    )
+    return data, reader.index_maps
+
+
+def _assert_game_data_equal(a, b):
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.offsets, b.offsets)
+    np.testing.assert_array_equal(a.weights, b.weights)
+    for shard in a.feature_shards:
+        ma, mb = a.feature_shards[shard], b.feature_shards[shard]
+        np.testing.assert_array_equal(ma.indptr, mb.indptr)
+        np.testing.assert_array_equal(ma.indices, mb.indices)
+        np.testing.assert_array_equal(ma.values, mb.values)
+
+
+def test_transient_decode_fault_retries_to_identical_read(avro_dir):
+    clean, maps = _read(avro_dir)
+    obs.enable()
+    obs.reset()
+    try:
+        with faults.injected("io.decode@1=io_error"):
+            faulted, _ = _read(avro_dir, index_maps=maps)
+        assert _counters().get("retry.attempts.avro_read") == 1
+    finally:
+        obs.disable()
+        obs.reset()
+    _assert_game_data_equal(clean, faulted)
+
+
+def test_missing_file_is_not_retried(tmp_path):
+    from photon_tpu.io.data_reader import AvroDataReader, FeatureShardConfig
+
+    obs.enable()
+    obs.reset()
+    try:
+        with pytest.raises(FileNotFoundError):
+            AvroDataReader().read(
+                str(tmp_path / "nope" / "part-0.avro"),
+                {"g": FeatureShardConfig(feature_bags=("features",))},
+            )
+        assert _counters().get("retry.attempts.avro_read", 0) == 0
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_native_decode_fault_falls_back_to_identical_python_read(avro_dir):
+    clean, maps = _read(avro_dir)
+    with faults.injected("io.native_decode@1=io_error"):
+        faulted, _ = _read(avro_dir, index_maps=maps)
+    _assert_game_data_equal(clean, faulted)
+
+
+# ---------------------------------------------------------------------------
+# streaming faults: batch retry, producer watchdog
+# ---------------------------------------------------------------------------
+
+
+D_FE_S = 6
+
+
+def _fe_model(seed=0):
+    rng = np.random.default_rng(seed)
+    task = TaskType.LINEAR_REGRESSION
+    fe = FixedEffectModel(
+        model=model_for_task(
+            task, Coefficients(means=jnp.asarray(rng.normal(size=D_FE_S)))
+        ),
+        feature_shard="g",
+    )
+    return GameModel(coordinates={"fixed": fe}, task=task)
+
+
+def _fe_data(n=200, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, D_FE_S))
+    return GameData.build(
+        labels=rng.normal(size=n),
+        feature_shards={"g": CSRMatrix.from_dense(x)},
+        offsets=rng.normal(size=n),
+    )
+
+
+def _chunks(data, rows):
+    from photon_tpu.game.data import slice_game_data
+
+    for lo in range(0, data.num_samples, rows):
+        yield slice_game_data(data, lo, min(lo + rows, data.num_samples))
+
+
+def test_transient_batch_fault_requeues_to_identical_scores():
+    """scoring.batch → UNAVAILABLE on the first dispatch: the decoded
+    chunk is still on host, so the retry re-stages and re-dispatches it
+    — scores bit-exact, one retry counted."""
+    scorer = GameScorer(_fe_model(), batch_rows=64)
+    data = _fe_data()
+    clean = scorer.stream(_chunks(data, 64)).scores
+    with faults.injected("scoring.batch@1=unavailable"):
+        res = scorer.stream(_chunks(data, 64))
+    np.testing.assert_array_equal(clean, res.scores)
+    assert res.stats.batch_retries == 1
+    assert res.stats.batches == data.num_samples // 64 + 1
+
+
+def test_fatal_batch_fault_is_not_retried():
+    scorer = GameScorer(_fe_model(), batch_rows=64)
+    with faults.injected("scoring.batch@1=error"):
+        with pytest.raises(InjectedFault, match="injected fatal"):
+            scorer.stream(_chunks(_fe_data(), 64))
+
+
+@pytest.mark.filterwarnings(
+    # abrupt thread death IS the scenario: the injected fault escapes
+    # the producer uncaught by design (no sentinel, no _Failure)
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_producer_death_raises_clean_error_not_a_hang():
+    """scoring.producer → abrupt thread death (no sentinel, no
+    _Failure): the watchdog's liveness probe converts the would-be
+    eternal q.get() into ProducerDiedError within the poll interval."""
+    scorer = GameScorer(_fe_model(), batch_rows=64, watchdog_s=30)
+    with faults.injected("scoring.producer@1=error"):
+        with pytest.raises(ProducerDiedError):
+            scorer.stream(_chunks(_fe_data(), 64))
+    # the scorer stays usable after the failed stream
+    scores = scorer.stream(_chunks(_fe_data(), 64)).scores
+    assert len(scores) == 200
+
+
+def test_hung_producer_trips_stall_watchdog():
+    """scoring.producer → stall longer than the watchdog window: a
+    clean StreamStallError instead of a silent wedge."""
+    scorer = GameScorer(_fe_model(), batch_rows=64, watchdog_s=1.0)
+    with faults.injected("scoring.producer@1=stall:3"):
+        with pytest.raises(StreamStallError, match="watchdog"):
+            scorer.stream(_chunks(_fe_data(), 64))
+
+
+def test_stall_shorter_than_watchdog_only_delays():
+    scorer = GameScorer(_fe_model(), batch_rows=64, watchdog_s=30)
+    clean = scorer.stream(_chunks(_fe_data(), 64)).scores
+    with faults.injected("scoring.producer@1=stall:0.7"):
+        slow = scorer.stream(_chunks(_fe_data(), 64)).scores
+    np.testing.assert_array_equal(clean, slow)
+
+
+def test_watchdog_env_knob(monkeypatch):
+    monkeypatch.setenv("PHOTON_STREAM_WATCHDOG_S", "7.5")
+    assert GameScorer(_fe_model()).watchdog_s == 7.5
+    monkeypatch.setenv("PHOTON_STREAM_WATCHDOG_S", "-1")
+    with pytest.raises(ValueError):
+        GameScorer(_fe_model())
+
+
+# ---------------------------------------------------------------------------
+# recovery unit: classification + supervision loop
+# ---------------------------------------------------------------------------
+
+
+def test_classify_failure_taxonomy():
+    assert classify_failure(InjectedFault("UNAVAILABLE: flake")) == "transient"
+    assert classify_failure(InjectedIOError("torn read")) == "transient"
+    assert classify_failure(FileNotFoundError("gone")) == "fatal"
+    assert classify_failure(ValueError("bad shape")) == "fatal"
+    assert (
+        classify_failure(DivergenceError("c", 3, {"loss": float("nan")}))
+        == "divergent"
+    )
+
+
+def test_run_with_recovery_restarts_transients_and_counts():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise InjectedFault("UNAVAILABLE: flake")
+        return "ok"
+
+    obs.enable()
+    obs.reset()
+    try:
+        out = run_with_recovery(
+            flaky, max_restarts=2, sleep=lambda s: None
+        )
+        assert out == "ok" and calls["n"] == 3
+        c = _counters()
+        assert c.get("recovery.restarts") == 2
+        assert c.get("recovery.recovered") == 1
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_run_with_recovery_fatal_raises_immediately():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        run_with_recovery(broken, max_restarts=5, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_run_with_recovery_budget_exhaustion_gives_up():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise InjectedFault("UNAVAILABLE: forever")
+
+    obs.enable()
+    obs.reset()
+    try:
+        with pytest.raises(InjectedFault):
+            run_with_recovery(always, max_restarts=2, sleep=lambda s: None)
+        assert calls["n"] == 3  # 1 try + 2 restarts
+        assert _counters().get("recovery.giveup") == 1
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_retry_policy_schedule_is_capped_and_jittered():
+    import random
+
+    policy = RetryPolicy(
+        attempts=5, base_s=1.0, multiplier=4.0, cap_s=6.0, jitter=0.2
+    )
+    rng = random.Random(0)
+    waits = [policy.wait_s(k, rng) for k in range(4)]
+    assert 0.8 <= waits[0] <= 1.2            # base ± jitter
+    assert all(w <= 6.0 * 1.2 for w in waits)  # cap ± jitter
+    zero_j = RetryPolicy(attempts=2, base_s=1.0, jitter=0.0)
+    assert zero_j.wait_s(0, rng) == 1.0
+
+
+def test_retry_call_counts_and_exhausts():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise InjectedFault("UNAVAILABLE: forever")
+
+    obs.enable()
+    obs.reset()
+    try:
+        with pytest.raises(InjectedFault):
+            retry_call(
+                always,
+                policy=RetryPolicy(attempts=3, base_s=0.0, jitter=0.0),
+                label="unit",
+            )
+        assert calls["n"] == 3
+        c = _counters()
+        assert c.get("retry.attempts.unit") == 3
+        assert c.get("retry.exhausted.unit") == 1
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_nonfinite_health_samples_do_not_poison_metrics():
+    """Review pin: a diverged run's NaN/Inf health samples must neither
+    crash the registry (the original chaos find) nor poison the
+    streaming moments / the rendered summary — the export of exactly
+    the run whose divergence telemetry matters most must work."""
+    from photon_tpu.obs.export import histogram_summary
+    from photon_tpu.obs.metrics import MetricsRegistry
+
+    r = MetricsRegistry()
+    r.histogram("health.gnorm", float("nan"))       # all-NaN histogram
+    r.histogram("mixed", 10.0)
+    r.histogram("mixed", float("nan"))
+    r.histogram("mixed", float("-inf"))
+    snap = r.snapshot()["histograms"]
+    assert snap["mixed"]["sum"] == 10.0
+    assert snap["mixed"]["min"] == snap["mixed"]["max"] == 10.0
+    assert snap["mixed"]["nonfinite"] == 2
+    json.dumps(r.snapshot(), allow_nan=False)       # strict JSON holds
+    text = histogram_summary(r)                     # renders, no crash
+    assert "non-finite" in text
+    assert " 10 " in text.replace("10.0", "10 ") or "10" in text
+
+
+def test_full_disk_errors_are_not_transient():
+    """Review pin: ENOSPC/EROFS/EDQUOT do not heal inside a retry
+    window — they must classify permanent, not burn restarts."""
+    import errno as _errno
+
+    from photon_tpu.util.retry import is_transient_io
+
+    assert not is_transient_io(OSError(_errno.ENOSPC, "disk full"))
+    assert not is_transient_io(OSError(_errno.EROFS, "read-only fs"))
+    assert not is_transient_io(OSError(_errno.EDQUOT, "quota"))
+    assert is_transient_io(OSError(_errno.EIO, "flaky io"))
+    assert classify_failure(OSError(_errno.ENOSPC, "disk full")) == "fatal"
+
+
+def test_degrade_env_rejects_unparseable_values(monkeypatch):
+    import argparse
+
+    from photon_tpu.cli.game_scoring import _degrade_enabled
+
+    ns = argparse.Namespace(degrade_on_stream_failure=False)
+    monkeypatch.setenv("PHOTON_SCORE_DEGRADE", "true")
+    with pytest.raises(ValueError, match="PHOTON_SCORE_DEGRADE"):
+        _degrade_enabled(ns)
+    monkeypatch.setenv("PHOTON_SCORE_DEGRADE", "1")
+    assert _degrade_enabled(ns) is True
+    monkeypatch.delenv("PHOTON_SCORE_DEGRADE")
+    assert _degrade_enabled(ns) is False
+
+
+def test_estimator_max_restarts_env(monkeypatch):
+    monkeypatch.setenv("PHOTON_MAX_RESTARTS", "4")
+    assert _estimator().max_restarts == 4
+    monkeypatch.delenv("PHOTON_MAX_RESTARTS")
+    assert _estimator().max_restarts == 0
+    assert _estimator(max_restarts=2).max_restarts == 2

@@ -422,6 +422,60 @@ def test_scoring_driver_sharded_streaming_output(
                                atol=1e-5)
 
 
+@pytest.mark.filterwarnings(
+    # abrupt producer-thread death is the injected scenario
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_scoring_driver_degrades_to_monolithic_on_stream_failure(
+    avro_data, trained_model_dir, tmp_path, monkeypatch
+):
+    """Chaos: the decode producer dies mid-stream (PHOTON_FAULTS). With
+    the opt-in escape the driver degrades to the monolithic path and
+    completes with identical scores; without it the failure propagates."""
+    from photon_tpu.util import faults
+
+    out, _ = trained_model_dir
+    base_args = [
+        "--input-data-directories", str(avro_data / "valid"),
+        "--feature-shard-configurations", SHARD_ARG,
+        "--model-input-directory", str(out / "best"),
+        "--score-batch-rows", "64",
+    ]
+    clean = game_scoring.run(
+        base_args
+        + ["--root-output-directory", str(tmp_path / "clean")]
+    )
+
+    monkeypatch.setenv("PHOTON_FAULTS", "scoring.producer@1=error")
+    monkeypatch.setenv("PHOTON_STREAM_WATCHDOG_S", "10")
+    try:
+        # opt-out default: the stream failure is the run's failure
+        from photon_tpu.game.scoring import ProducerDiedError
+
+        with pytest.raises(ProducerDiedError):
+            game_scoring.run(
+                base_args
+                + ["--root-output-directory", str(tmp_path / "hard")]
+            )
+
+        degraded = game_scoring.run(
+            base_args
+            + [
+                "--root-output-directory", str(tmp_path / "degraded"),
+                "--degrade-on-stream-failure",
+            ]
+        )
+    finally:
+        faults.clear()
+    summary = json.loads(
+        (tmp_path / "degraded" / "scoring-summary.json").read_text()
+    )
+    assert summary["scoring"]["mode"] == "monolithic"
+    np.testing.assert_allclose(
+        degraded["scores"], clean["scores"], rtol=1e-5, atol=1e-5
+    )
+
+
 def test_scoring_driver_bad_batch_rows_raises(
     avro_data, trained_model_dir, tmp_path
 ):
