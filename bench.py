@@ -941,6 +941,32 @@ def config_sparse_poisson(peak_flops, scale):
 # ---------------------------------------------------------------------------
 
 
+def _start_series_flusher(config_name: str):
+    """Per-config time-resolved metric series (photon_tpu/obs/series):
+    one ``<config>.series.jsonl`` trajectory under $PHOTON_OBS_DIR —
+    the within-run throughput signal the terminal bench averages can't
+    see (``scripts/bench_trend.py --series`` plots/gates it). Local
+    instance, not the process-global flusher: bench runs configs back
+    to back and each file must hold exactly one run."""
+    from photon_tpu.obs.series import SeriesFlusher, flush_interval_s
+
+    interval = flush_interval_s()
+    if interval == 0:
+        return None
+    obs_dir = os.environ.get("PHOTON_OBS_DIR", "bench_obs")
+    os.makedirs(obs_dir, exist_ok=True)
+    path = os.path.join(obs_dir, f"{config_name}.series.jsonl")
+    open(path, "w").close()  # one run per file, not append-across-runs
+    return SeriesFlusher(path, interval).start()
+
+
+def _stop_series_flusher(flusher) -> str | None:
+    if flusher is None:
+        return None
+    flusher.stop()
+    return flusher.path
+
+
 def _zipf_ids(rng, n, num_entities, a=1.3):
     """Zipf-skewed entity sizes with guaranteed coverage: when the sample
     budget allows, every entity appears at least once (otherwise raw Zipf
@@ -1034,6 +1060,7 @@ def _run_game_config(
     # one artifact set per config run: clean slate, then enable
     obs.reset()
     obs.enable()
+    series_flusher = _start_series_flusher(config_name)
 
     from photon_tpu.game.config import (
         FixedEffectCoordinateConfig,
@@ -1343,6 +1370,7 @@ def _run_game_config(
     from photon_tpu.obs import phase_summary, summary_table
 
     obs_dir = os.environ.get("PHOTON_OBS_DIR", "bench_obs")
+    series_path = _stop_series_flusher(series_flusher)
     paths = obs.export_artifacts(
         obs_dir,
         prefix=f"{config_name}.",
@@ -1353,6 +1381,7 @@ def _run_game_config(
         "metrics_path": paths["metrics"],
         "manifest_path": paths["manifest"],
         "memory_path": paths["memory"],
+        "series_path": series_path,
         "phase_wall_s": {
             name: agg["total_s"] for name, agg in phase_summary().items()
         },
@@ -1712,12 +1741,14 @@ def config_scoring_stream(peak_flops, scale):
         _, m1_wall = run_mono()
         obs.reset()
         obs.enable()
+        series_flusher = _start_series_flusher("game_scoring_stream")
         cw_before = compile_watch.snapshot()
         s2, s2_wall = run_stream()
         steady_compiles = compile_watch.delta(cw_before)["backend_compiles"]
         from photon_tpu.obs import phase_summary, summary_table
 
         obs_dir = os.environ.get("PHOTON_OBS_DIR", "bench_obs")
+        series_path = _stop_series_flusher(series_flusher)
         paths = obs.export_artifacts(
             obs_dir,
             prefix="game_scoring_stream.",
@@ -1728,6 +1759,7 @@ def config_scoring_stream(peak_flops, scale):
             "metrics_path": paths["metrics"],
             "manifest_path": paths["manifest"],
             "memory_path": paths["memory"],
+            "series_path": series_path,
             "phase_wall_s": {
                 name: agg["total_s"]
                 for name, agg in phase_summary().items()

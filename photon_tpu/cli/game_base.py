@@ -126,7 +126,7 @@ def ensure_single_process_jax() -> None:
 
 
 @contextlib.contextmanager
-def run_profile():
+def run_profile(out_root=None):
     """Telemetry session for one driver run: enable the spine
     (photon_tpu/obs) from a clean slate on entry, and ALWAYS disable and
     drop the recorded spans on exit — success or failure — so a
@@ -136,6 +136,18 @@ def run_profile():
     (PERF.md r7) and the artifacts are what make a slow run debuggable
     after the fact. Artifacts must be exported inside the session
     (``export_run_profile``).
+
+    ``out_root`` additionally arms the LIVE telemetry plane under
+    ``<out_root>/obs/``: first, any stale flight ring a DEAD previous
+    run left behind (a real SIGKILL mid-fit) is reconstructed into a
+    ``blackbox-<seq>.json`` so the relaunch reports what the dead
+    process was doing; then the mmap flight recorder + crash handlers,
+    the series flusher (``PHOTON_OBS_FLUSH_S``), and the opt-in HTTP
+    endpoints (``PHOTON_OBS_HTTP_PORT``) run for the session, all torn
+    down in the ``finally``. A run that FAILS exports best-effort
+    partial artifacts (``partial.metrics.json`` + summary + manifest)
+    and a blackbox dump before the exception propagates — a crashed run
+    is no longer telemetry-free.
 
     ``PHOTON_OBS=0`` opts the driver out of MANAGING the pipeline
     entirely: nothing is enabled on entry and — just as important —
@@ -149,11 +161,42 @@ def run_profile():
         return
     obs.enable()
     obs.reset()
+    plane = None
     try:
-        yield
+        if out_root is not None:
+            plane = obs.live_plane(os.path.join(str(out_root), "obs"))
+        try:
+            yield
+        except BaseException as e:
+            _export_failure_artifacts(out_root, e)
+            raise
     finally:
+        if plane is not None:
+            plane.close()
         obs.disable()
         obs.reset()
+
+
+def _export_failure_artifacts(out_root, exc: BaseException) -> None:
+    """The failed-run telemetry flush: blackbox dump + best-effort
+    partial metrics/summary/manifest under ``<out_root>/obs/``. Every
+    step is guarded — telemetry must never mask the real failure."""
+    from photon_tpu import obs
+
+    if out_root is None or not obs.enabled():
+        return
+    reason = f"{type(exc).__name__}: {exc}"
+    try:
+        obs.flight.dump_blackbox(reason=reason)
+    except Exception:  # pragma: no cover - dump_blackbox already guards
+        pass
+    try:
+        obs.export_partial_artifacts(
+            os.path.join(str(out_root), "obs"),
+            meta={"failed": True, "error": reason},
+        )
+    except Exception:  # pragma: no cover - exporter already guards
+        pass
 
 
 def export_run_profile(out_root, log=None, meta=None) -> dict | None:

@@ -259,7 +259,7 @@ def run(argv=None) -> dict:
         args.root_output_directory, override=args.override_output_directory
     )
     emitter = EventEmitter()
-    with game_base.run_profile(), PhotonLogger(
+    with game_base.run_profile(out_root), PhotonLogger(
         os.path.join(out_root, "driver.log"), level=args.log_level
     ) as log:
         emitter.emit("setup", application=args.application_name)

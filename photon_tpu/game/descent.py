@@ -462,6 +462,10 @@ def run_coordinate_descent(
                 _cl = faults.fault_point("descent.coordinate")
                 if _cl is not None and _cl.kind == "nan":
                     states[cid] = _poison_state_nan(states[cid])
+                # flight-recorder tap (host dict only; two global reads
+                # when no recorder is installed): the blackbox of a run
+                # killed mid-sweep names the coordinate it was enqueuing
+                obs.flight.record("coordinate", iteration=it, coordinate=cid)
                 with obs.span(
                     "descent.coordinate", iteration=it, coordinate=cid
                 ) as coord_span:
@@ -567,6 +571,17 @@ def run_coordinate_descent(
         obs.histogram("descent.sweep_seconds", sweep_span.duration_s)
         obs.histogram("descent.barrier_seconds", barrier_s)
         _record_health_metrics(health)
+        # flight-recorder tap at the barrier choke point: every value
+        # here is a host scalar the sweep's ONE read-back already
+        # fetched — the tap adds zero dispatches and zero syncs
+        obs.flight.record(
+            "sweep",
+            iteration=it,
+            sweep_seconds=round(sweep_span.duration_s, 6),
+            barrier_seconds=round(barrier_s, 6),
+            dispatches=dispatches,
+            health=health,
+        )
         diverged = [
             cid for cid, h in health.items() if not h["finite"]
         ]
@@ -574,6 +589,13 @@ def run_coordinate_descent(
             sweep_hook(it, sweep_row)
         for cid in diverged:
             obs.counter("health.divergence")
+            obs.flight.record(
+                "divergence",
+                coordinate=cid,
+                iteration=it,
+                policy=on_divergence,
+                health_row=health[cid],
+            )
             obs.instant(
                 "health.divergence",
                 cat="lifecycle",

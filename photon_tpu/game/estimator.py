@@ -359,6 +359,12 @@ class GameEstimator:
             grid_length=self._grid_length(),
         ) as fit_span:
             obs.counter("fit.count")
+            obs.flight.record(
+                "fit",
+                task=self.task.name,
+                coordinates=len(self.coordinate_configs),
+                grid_length=self._grid_length(),
+            )
             if emitter is not None:
                 emitter.emit(
                     "setup",
@@ -609,6 +615,7 @@ class GameEstimator:
                     )
                 )
 
+            obs.flight.record("grid", grid_index=gi)
             with compile_watch.watch() as grid_compiles, obs.span(
                 "fit.grid", grid_index=gi
             ):

@@ -750,6 +750,14 @@ class GameScorer:
             obs.counter("score.batches")
             obs.counter("score.samples", chunk.num_samples)
             obs.histogram("score.batch_seconds", wall)
+            # flight-recorder tap at the read-back choke point: host
+            # values the batch's sanctioned D2H already produced
+            obs.flight.record(
+                "score_batch",
+                batch=stats.batches,
+                rows=chunk.num_samples,
+                wall_s=round(wall, 6),
+            )
             if collected is not None:
                 collected.append(scores)
             if on_batch is not None:

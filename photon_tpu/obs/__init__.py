@@ -16,6 +16,17 @@ layer with three parts:
   Perfetto / ``chrome://tracing``), a JSONL run manifest, and a
   human-readable per-phase summary table.
 
+The LIVE half (everything above exports at end of run) is the
+telemetry plane, composed per run by :class:`LiveTelemetryPlane`:
+
+- :mod:`photon_tpu.obs.flight` — a crash-surviving mmap ring of recent
+  span/event/metric records (``blackbox.ring``) with blackbox dumps on
+  fatal signals and stale-ring recovery after a real SIGKILL;
+- :mod:`photon_tpu.obs.series` — periodic registry-delta JSONL rows
+  (``series.jsonl``), so runs yield time-resolved trajectories;
+- :mod:`photon_tpu.obs.http` — opt-in ``/metrics`` (Prometheus text) /
+  ``/healthz`` / ``/blackbox`` endpoints served from the live process.
+
 The module-level functions operate on ONE process-global pipeline
 (default tracer + default registry) gated by a single enable switch, so
 instrumentation sites stay one-liners::
@@ -36,12 +47,14 @@ profile of a run.
 """
 from __future__ import annotations
 
+import logging
 import os
 
-from photon_tpu.obs import health, memory
+from photon_tpu.obs import flight, health, http, memory, series
 from photon_tpu.obs.export import (
     chrome_trace,
     export_artifacts,
+    export_partial_artifacts,
     histogram_summary,
     phase_summary,
     summary_table,
@@ -54,6 +67,7 @@ from photon_tpu.obs.metrics import MetricsRegistry
 from photon_tpu.obs.tracer import Span, Tracer
 
 __all__ = [
+    "LiveTelemetryPlane",
     "MetricsRegistry",
     "Span",
     "Tracer",
@@ -63,16 +77,21 @@ __all__ = [
     "enable",
     "enabled",
     "export_artifacts",
+    "export_partial_artifacts",
+    "flight",
     "gauge",
     "get_registry",
     "get_tracer",
     "health",
     "histogram",
     "histogram_summary",
+    "http",
     "instant",
+    "live_plane",
     "memory",
     "phase_summary",
     "reset",
+    "series",
     "span",
     "summary_table",
     "write_chrome_trace",
@@ -80,6 +99,8 @@ __all__ = [
     "write_metrics",
     "write_run_manifest",
 ]
+
+logger = logging.getLogger(__name__)
 
 _tracer = Tracer(enabled=os.environ.get("PHOTON_OBS", "") == "1")
 _registry = MetricsRegistry()
@@ -148,3 +169,64 @@ def histogram(name: str, value: float) -> None:
     disabled)."""
     if _tracer.enabled:
         _registry.histogram(name, value)
+
+
+class LiveTelemetryPlane:
+    """The always-on half of the spine for ONE run directory: stale-ring
+    recovery (what a SIGKILLed previous run was doing → ``blackbox-
+    <seq>.json``), the mmap flight recorder + crash handlers, the series
+    flusher (``series.jsonl``), and the opt-in HTTP endpoints — composed
+    with one ``start()``/``close()`` pair so the drivers' ``run_profile``
+    can finally-guard the whole plane. Every piece is individually
+    optional (``PHOTON_OBS_RING_MB=0``, ``PHOTON_OBS_FLUSH_S=0``, unset
+    ``PHOTON_OBS_HTTP_PORT``) and teardown is LIFO with each step
+    guarded: telemetry must never fail — or leak past — the run."""
+
+    def __init__(self, directory):
+        self.directory = str(directory)
+        self.recovered_blackbox: str | None = None
+        self.recorder = None
+        self.flusher = None
+        self.server = None
+
+    def start(self) -> "LiveTelemetryPlane":
+        """Arm the plane. Exception-safe: if any later step fails (an
+        invalid knob value, the configured port already bound), every
+        piece armed so far is torn down BEFORE the error propagates —
+        the operator who set a bad knob gets a loud failure (the repo's
+        knob-validation convention), never a half-armed plane leaking
+        crash handlers and threads into the rest of the process."""
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            self.recovered_blackbox = flight.recover_stale(self.directory)
+            self.recorder = flight.enable(self.directory)
+            if self.recorder is not None:
+                flight.install_crash_handler()
+            self.flusher = series.start_flusher(
+                os.path.join(self.directory, "series.jsonl")
+            )
+            self.server = http.start_from_env()
+        except BaseException:
+            self.close()
+            raise
+        return self
+
+    def close(self) -> None:
+        for step in (
+            http.stop_server,
+            series.stop_flusher,
+            flight.uninstall_crash_handler,
+            flight.disable,
+        ):
+            try:
+                step()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning(
+                    "telemetry-plane teardown step %s failed: %s: %s",
+                    step.__name__, type(e).__name__, e,
+                )
+
+
+def live_plane(directory) -> LiveTelemetryPlane:
+    """Start a :class:`LiveTelemetryPlane` under ``directory``."""
+    return LiveTelemetryPlane(directory).start()

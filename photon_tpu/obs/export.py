@@ -190,6 +190,60 @@ def export_artifacts(
     return paths
 
 
+def export_partial_artifacts(
+    directory,
+    prefix: str = "partial.",
+    tracer=None,
+    registry=None,
+    meta: dict | None = None,
+) -> dict:
+    """Best-effort artifact export for a FAILED or interrupted run: the
+    metrics snapshot, the per-phase/histogram summary, and the JSONL
+    manifest, each written INDEPENDENTLY so one exporter choking on the
+    crash's half-built state cannot take the others with it (a crashed
+    run used to export nothing at all — `run_profile` calls this from
+    its failure path, next to the blackbox dump). Returns the paths that
+    actually got written."""
+    try:
+        os.makedirs(directory, exist_ok=True)
+    except OSError:
+        return {}
+    tracer, registry = _resolve(tracer, registry)
+
+    def _path(name: str) -> str:
+        return os.path.join(str(directory), prefix + name)
+
+    def _summary() -> str:
+        p = _path("summary.txt")
+        with open(p, "w") as f:
+            f.write(summary_table(tracer) + "\n")
+            hist_block = histogram_summary(registry)
+            if hist_block:
+                f.write("\n" + hist_block + "\n")
+        return p
+
+    paths: dict = {}
+    for name, writer in (
+        ("metrics", lambda: write_metrics(_path("metrics.json"), registry, meta)),
+        (
+            "manifest",
+            lambda: write_run_manifest(
+                _path("manifest.jsonl"), tracer, registry, meta
+            ),
+        ),
+        ("summary", _summary),
+    ):
+        try:
+            paths[name] = writer()
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "partial %s export failed: %s: %s", name, type(e).__name__, e
+            )
+    return paths
+
+
 def write_memory_report(path, meta: dict | None = None) -> str:
     """The device-memory ledger (photon_tpu/obs/memory.py) as one JSON
     document: per-executable static footprints, phase-boundary live

@@ -88,7 +88,12 @@ class MetricsRegistry:
     """Thread-safe metrics container."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # REENTRANT: the flight recorder's fatal-signal handler calls
+        # snapshot() from whatever bytecode boundary the signal landed
+        # on — including inside counter()/histogram() on the same
+        # thread, where a plain Lock would deadlock the dying process
+        # (see photon_tpu/obs/flight.py crash handlers)
+        self._lock = threading.RLock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._hists: dict[str, dict] = {}

@@ -30,10 +30,23 @@ Exit status: 0 = healthy (including "nothing comparable"), 3 = the
 fresh run violates a quality band or regresses beyond tolerance.
 ``--out`` writes the machine-readable trend document CI uploads.
 
+**Within-run decay** (``--series``): the obs series flusher
+(photon_tpu/obs/series.py) writes one ``*.series.jsonl`` trajectory per
+run — counter DELTAS per flush interval, so throughput over TIME falls
+out as ``delta / interval_s``. ``--series <glob>`` plots each file's
+per-interval rate as a sparkline table (the signal a terminal average
+can't see: a stream that started at 90k samples/s and finished at 30k
+still averages "fine"), and ``--series-tolerance R`` gates it: the
+LAST interval's rate dropping below ``R × peak`` rate is a within-run
+decay failure (exit 3). Default metric ``auto`` picks the busiest of
+``score.samples`` / ``descent.sweeps`` / ``io.records``.
+
 Usage::
 
     python scripts/bench_trend.py                        # history table only
     python scripts/bench_trend.py --fresh BENCH_partial.json --out trend.json
+    python scripts/bench_trend.py --series 'bench_obs/*.series.jsonl' \\
+        --series-tolerance 0.5
 """
 from __future__ import annotations
 
@@ -205,6 +218,105 @@ def judge_fresh(
     return verdicts
 
 
+#: candidate rate counters for ``--series-metric auto``, tried in order
+#: of how directly they measure work done
+AUTO_SERIES_METRICS = ("score.samples", "descent.sweeps", "io.records")
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def load_series_rows(path: str) -> list[dict]:
+    """Rows of a ``series.jsonl`` trajectory — the flusher's own reader
+    (one parsing contract, incl. the skip-truncated-tail semantics).
+    ``photon_tpu.obs.series`` is stdlib-only at import time (no jax),
+    so the gate stays runnable on boxes without an accelerator stack."""
+    from photon_tpu.obs.series import read_series
+
+    return read_series(path)
+
+
+def series_rates(rows: list[dict], metric: str) -> list[tuple[float, float]]:
+    """``(t_s, rate)`` per measurable interval for ``metric``. LEADING
+    zero-rate intervals trim (ramp-up before the metric starts moving)
+    and at most ONE trailing zero trims (the flusher's final stop() row
+    covers the teardown/export window — a healthy run leaves exactly
+    one). Every other zero stays: a run that hard-stalls keeps flushing
+    zero rows while it hangs, and those must read as rate 0 — the worst
+    within-run decay is the one where work stops entirely, and a
+    drop-zero filter would leave the last HEALTHY rate as 'last'."""
+    out = []
+    for row in rows:
+        dt = row.get("interval_s") or 0.0
+        delta = row.get("counters", {}).get(metric, 0)
+        if dt > 1e-6:
+            out.append((float(row.get("t_s", 0.0)), delta / dt))
+    lo = 0
+    while lo < len(out) and out[lo][1] == 0:
+        lo += 1
+    out = out[lo:]
+    if out and out[-1][1] == 0 and (len(out) < 2 or out[-2][1] != 0):
+        out = out[:-1]
+    return out
+
+
+def judge_series_file(
+    path: str, metric: str, tolerance: float | None
+) -> dict:
+    """Within-run decay verdict for one trajectory file: sparkline of
+    per-interval rates + a fail when the trailing rate sagged below
+    ``tolerance × peak``. With fewer than 3 measurable intervals the
+    file is report-only — one or two points cannot show decay."""
+    rows = load_series_rows(path)
+    name = os.path.basename(path)
+    if metric == "auto":
+        totals = {
+            m: sum(r.get("counters", {}).get(m, 0) for r in rows)
+            for m in AUTO_SERIES_METRICS
+        }
+        metric = max(totals, key=lambda m: totals[m])
+        if totals[metric] == 0:
+            return {
+                "file": name,
+                "status": "ok",
+                "metric": None,
+                "notes": ["no known rate counter moved in this run"],
+            }
+    rates = series_rates(rows, metric)
+    v: dict = {
+        "file": name,
+        "metric": metric,
+        "status": "ok",
+        "notes": [],
+        "intervals": len(rates),
+        "rates": [round(r, 3) for _, r in rates],
+    }
+    if len(rates) < 3:
+        v["notes"].append(
+            f"only {len(rates)} measurable interval(s) — report-only "
+            "(decay needs a trajectory)"
+        )
+        return v
+    peak = max(r for _, r in rates)
+    last = rates[-1][1]
+    v["peak_rate"] = round(peak, 3)
+    v["last_rate"] = round(last, 3)
+    v["last_over_peak"] = round(last / peak, 3)
+    v["sparkline"] = "".join(
+        _SPARK_BLOCKS[
+            min(len(_SPARK_BLOCKS) - 1, int(r / peak * len(_SPARK_BLOCKS)))
+        ]
+        for _, r in rates
+    )
+    if tolerance is not None and last < tolerance * peak:
+        v["status"] = "fail"
+        v["notes"].append(
+            f"within-run decay: last interval {last:.1f}/s is "
+            f"{last / peak:.2f}x of peak {peak:.1f}/s "
+            f"(tolerance {tolerance:.2f}x)"
+        )
+    return v
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -229,6 +341,28 @@ def main(argv=None) -> int:
         "comparable row (default 0.25)",
     )
     ap.add_argument("--out", default=None, help="write the trend JSON here")
+    ap.add_argument(
+        "--series",
+        default=None,
+        metavar="GLOB",
+        help="within-run trajectories to plot/gate: a glob of "
+        "*.series.jsonl files written by the obs series flusher",
+    )
+    ap.add_argument(
+        "--series-metric",
+        default="auto",
+        help="counter whose per-interval rate is the within-run signal "
+        "(default auto: busiest of score.samples / descent.sweeps / "
+        "io.records)",
+    )
+    ap.add_argument(
+        "--series-tolerance",
+        type=float,
+        default=None,
+        metavar="R",
+        help="gate within-run decay: fail when the last interval's rate "
+        "drops below R x the run's peak rate (unset: report only)",
+    )
     args = ap.parse_args(argv)
 
     paths = sorted(glob.glob(args.history))
@@ -269,6 +403,30 @@ def main(argv=None) -> int:
         trend = f" {vs['ratio']}x vs {vs['round']}" if vs else ""
         print(f"[{marker}] {v['config']}{trend} {notes}".rstrip())
 
+    series_verdicts: list[dict] = []
+    if args.series:
+        series_paths = sorted(glob.glob(args.series))
+        if not series_paths:
+            print(f"-- no series files match {args.series!r}")
+        for path in series_paths:
+            v = judge_series_file(
+                path, args.series_metric, args.series_tolerance
+            )
+            series_verdicts.append(v)
+            marker = "FAIL" if v["status"] == "fail" else "ok"
+            spark = v.get("sparkline", "")
+            rate = (
+                f" last/peak {v['last_over_peak']}x"
+                if "last_over_peak" in v
+                else ""
+            )
+            notes = "; ".join(v["notes"]) if v["notes"] else ""
+            print(
+                f"[{marker}] within-run {v['file']} "
+                f"({v.get('metric')}/s) {spark}{rate} {notes}".rstrip()
+            )
+    failed_series = [v for v in series_verdicts if v["status"] == "fail"]
+
     if args.out:
         doc = {
             "rounds": [e["round"] for e in entries],
@@ -283,12 +441,14 @@ def main(argv=None) -> int:
             },
             "verdicts": verdicts,
             "tolerance": args.tolerance,
+            "within_run": series_verdicts,
+            "series_tolerance": args.series_tolerance,
         }
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)
         print(f"wrote trend document to {args.out}")
 
-    return 3 if failed else 0
+    return 3 if (failed or failed_series) else 0
 
 
 if __name__ == "__main__":

@@ -12,7 +12,12 @@ no-fault legs:
   leg B  SIGKILL mid-fit (descent.sweep@2=kill) on a checkpointed run,
          then a RELAUNCH of the same command with faults cleared — the
          acceptance scenario: resume from the newest valid checkpoint,
-         model hash equal to the uninterrupted run's.
+         model hash equal to the uninterrupted run's. The relaunch must
+         ALSO read the dead run's mmap flight ring (obs/blackbox.ring —
+         SIGKILL runs no cleanup, the kernel owns the dirty pages) and
+         reconstruct what it was doing into a blackbox-<seq>.json:
+         last completed sweep, last enqueued coordinate, last health
+         scalars (photon_tpu/obs/flight.py).
   leg C  producer-thread death mid-stream with the opt-in degrade
          escape (PHOTON_SCORE_DEGRADE=1) — the scoring driver must
          complete monolithically with scores matching the clean run.
@@ -23,6 +28,7 @@ Exit 0 = every leg green; non-zero with a named failure otherwise.
 from __future__ import annotations
 
 import argparse
+import glob
 import hashlib
 import json
 import os
@@ -235,6 +241,9 @@ def main() -> int:
     ckpt_manifest = os.path.join(b_out, "checkpoints", "descent-checkpoint.json")
     if not os.path.exists(ckpt_manifest):
         raise SystemExit("[chaos] legB: no checkpoint survived the kill")
+    ring_path = os.path.join(b_out, "obs", "blackbox.ring")
+    if not os.path.exists(ring_path):
+        raise SystemExit("[chaos] legB: no flight ring survived the kill")
     run_cli(
         train_mod,
         training_args(data_root, b_out, checkpoint=True),
@@ -245,7 +254,42 @@ def main() -> int:
         raise SystemExit(
             f"[chaos] legB PARITY FAIL: {b_hash[:16]}… != {base_hash[:16]}…"
         )
-    print("[chaos] legB ok: SIGKILL → relaunch resumed, model bit-exact")
+    # the flight-recorder acceptance: the relaunch found the dead run's
+    # ring (no clean-close marker — SIGKILL runs no cleanup) and wrote a
+    # blackbox-<seq>.json naming its last sweep / coordinate / health
+    blackboxes = [
+        json.load(open(p))
+        for p in sorted(glob.glob(os.path.join(b_out, "obs", "blackbox-*.json")))
+    ]
+    recovered = [bb for bb in blackboxes if bb.get("recovered")]
+    if not recovered:
+        raise SystemExit(
+            "[chaos] legB: relaunch did not recover a blackbox from the "
+            "dead run's ring"
+        )
+    bb = recovered[-1]
+    last_sweep = bb.get("last_sweep")
+    last_coord = bb.get("last_coordinate")
+    if last_sweep is None or "iteration" not in last_sweep:
+        raise SystemExit(
+            f"[chaos] legB: blackbox has no last-sweep record: {last_sweep}"
+        )
+    if not (last_sweep.get("health") or bb.get("last_health")):
+        raise SystemExit(
+            "[chaos] legB: blackbox carries no health scalars for the "
+            "dead run's last sweep"
+        )
+    if last_coord is None or "coordinate" not in last_coord:
+        raise SystemExit(
+            f"[chaos] legB: blackbox has no last-coordinate record: "
+            f"{last_coord}"
+        )
+    print(
+        f"[chaos] legB ok: SIGKILL → relaunch resumed, model bit-exact; "
+        f"blackbox recovered {len(bb['records'])} records (last sweep "
+        f"{last_sweep['iteration']}, last coordinate "
+        f"{last_coord['coordinate']!r})"
+    )
 
     # -- leg C: producer death mid-stream, degrade escape --------------
     clean_out = os.path.join(work, "score-clean")

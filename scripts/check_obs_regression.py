@@ -146,10 +146,25 @@ def collect_snapshot() -> dict:
         k: os.environ.pop(k)
         for k in list(os.environ)
         if k.startswith("PHOTON_SCORE_")
-        or k in ("PHOTON_OBS_MEM", "PHOTON_ON_DIVERGENCE")
+        or k
+        in (
+            "PHOTON_OBS_MEM",
+            "PHOTON_ON_DIVERGENCE",
+            # live-plane knobs: an exported ring size / flush cadence /
+            # port must not change the canonical recorder.* / obs.flush.*
+            # counts (the recorder below is installed explicitly)
+            "PHOTON_OBS_RING_MB",
+            "PHOTON_OBS_FLUSH_S",
+            "PHOTON_OBS_HTTP_PORT",
+        )
     }
+    flight_dir = None
     try:
+        import tempfile
+
         from photon_tpu.game.scoring import GameScorer
+        from photon_tpu.obs import flight
+        from photon_tpu.obs.series import SeriesFlusher
 
         # Warm-up pass with THROWAWAY estimator/scorer instances (jit
         # caches key on static self, so the canonical fit below still
@@ -170,6 +185,14 @@ def collect_snapshot() -> dict:
         est, data = build_canonical_fit()
         obs.reset()
         obs.enable()
+        # the live-plane taps are part of the gated metric shape: the
+        # canonical fit runs WITH the flight recorder installed (its
+        # per-tap ``recorder.records`` count is structural — a new or
+        # removed tap is a reviewed change) and one deterministic
+        # series flush (``obs.flush.rows`` = 1; the thread never starts,
+        # so the count cannot depend on machine speed)
+        flight_dir = tempfile.mkdtemp(prefix="obs-gate-ring-")
+        flight.enable(flight_dir)
         results = est.fit(data)
         # canonical streaming score: the fitted model over the same 400
         # rows in fixed-size batches — emits the score.* spans/counters
@@ -178,8 +201,13 @@ def collect_snapshot() -> dict:
         GameScorer(
             results[0].model, batch_rows=SCORE_BATCH_ROWS
         ).score_data(data)
+        SeriesFlusher(
+            os.path.join(flight_dir, "series.jsonl"), 60.0
+        ).flush_once()
     finally:
         obs.disable()
+        if flight_dir is not None:
+            flight.disable()
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         os.environ.update(saved_env)
     snap = obs.get_registry().snapshot()

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Live-endpoint probe: scrape a REAL training run mid-flight.
+
+The CI leg for the ISSUE 11 live telemetry plane: launch the actual
+GAME training driver as a subprocess with the HTTP endpoints armed
+(``PHOTON_OBS_HTTP_PORT``) and a fast series cadence
+(``PHOTON_OBS_FLUSH_S``), then — while the fit is still running —
+
+1. GET ``/metrics`` and parse it with the vendored Prometheus
+   text-format parser (``photon_tpu.obs.http.parse_prometheus_text``):
+   non-empty, well-formed, and carrying ``photon_*`` families;
+2. GET ``/healthz`` and check the liveness document's shape (status,
+   recovery counters, recorder/flusher liveness);
+3. after the driver exits 0, check the run's ``obs/series.jsonl``
+   trajectory has parseable rows and the flight ring closed clean.
+
+Exit 0 = all probes green; non-zero with a named failure otherwise.
+
+Usage: python scripts/live_probe.py [--workdir DIR] [--n 400]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from chaos_drive import training_args, write_data  # noqa: E402
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def get(url: str, timeout: float = 5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--n", type=int, default=400)
+    ap.add_argument(
+        "--deadline", type=float, default=300.0,
+        help="seconds to wait for the endpoints, then the driver exit",
+    )
+    args = ap.parse_args()
+
+    from photon_tpu.obs.http import parse_prometheus_text
+
+    work = args.workdir or tempfile.mkdtemp(prefix="photon-live-probe-")
+    os.makedirs(work, exist_ok=True)
+    data_root = os.path.join(work, "data")
+    write_data(data_root, args.n)
+    out_root = os.path.join(work, "train")
+    port = free_port()
+
+    env = dict(os.environ)
+    env.pop("PHOTON_FAULTS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PHOTON_OBS_HTTP_PORT"] = str(port)
+    env["PHOTON_OBS_FLUSH_S"] = "1"
+    cmd = [
+        sys.executable, "-m", "photon_tpu.cli.game_training",
+        *training_args(data_root, out_root),
+    ]
+    print(f"[probe] launching driver with endpoints on :{port}")
+    # driver output goes to a FILE, not a pipe: nothing drains a pipe
+    # while the probe waits, and a chatty driver filling the ~64 KiB
+    # pipe buffer would block in write() and never exit
+    log_path = os.path.join(work, "driver.out")
+    driver_log = open(log_path, "w")
+    proc = subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=driver_log, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # -- probe 1: /metrics mid-run --------------------------------
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + args.deadline
+        body = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                print(open(log_path).read()[-4000:])
+                raise SystemExit(
+                    f"[probe] driver exited rc={proc.returncode} before "
+                    "the endpoints answered"
+                )
+            try:
+                body = get(base + "/metrics").decode()
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.25)
+        if body is None:
+            raise SystemExit("[probe] /metrics never became reachable")
+        if proc.poll() is not None:
+            raise SystemExit("[probe] scrape was not mid-run")
+        families = parse_prometheus_text(body)  # raises on malformed text
+        if not families:
+            raise SystemExit("[probe] /metrics parsed but has no families")
+        if not any(name.startswith("photon_") for name in families):
+            raise SystemExit(
+                f"[probe] no photon_* families in /metrics: "
+                f"{sorted(families)[:5]}"
+            )
+        print(
+            f"[probe] /metrics ok mid-run: {len(families)} families, e.g. "
+            f"{sorted(families)[:3]}"
+        )
+
+        # -- probe 2: /healthz mid-run --------------------------------
+        hz = json.loads(get(base + "/healthz"))
+        for key in ("status", "recovery", "watchdog", "recorder", "flusher"):
+            if key not in hz:
+                raise SystemExit(f"[probe] /healthz missing {key!r}: {hz}")
+        if hz["status"] not in ("ok", "diverged"):
+            raise SystemExit(f"[probe] /healthz bad status: {hz['status']}")
+        print(
+            f"[probe] /healthz ok mid-run: status={hz['status']} "
+            f"recorder_seq={(hz['recorder'] or {}).get('last_seq')}"
+        )
+
+        # -- driver must still finish clean ---------------------------
+        rc = proc.wait(timeout=max(10.0, deadline - time.monotonic()))
+        if rc != 0:
+            print(open(log_path).read()[-4000:])
+            raise SystemExit(f"[probe] driver failed rc={rc}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        driver_log.close()
+
+    # -- probe 3: the series trajectory + clean ring ------------------
+    series_path = os.path.join(out_root, "obs", "series.jsonl")
+    if not os.path.exists(series_path):
+        raise SystemExit(f"[probe] no series trajectory at {series_path}")
+    from photon_tpu.obs.series import read_series
+
+    rows = read_series(series_path)  # the flusher's own reader
+    if not rows:
+        raise SystemExit("[probe] series.jsonl is empty")
+    if any("counters" not in r or "interval_s" not in r for r in rows):
+        raise SystemExit("[probe] malformed series rows")
+    from photon_tpu.obs.flight import FlightRecorder
+
+    _, clean = FlightRecorder.read_file(
+        os.path.join(out_root, "obs", "blackbox.ring")
+    )
+    if not clean:
+        raise SystemExit(
+            "[probe] flight ring not clean-closed after a clean exit"
+        )
+    print(
+        f"[probe] series ok: {len(rows)} rows; ring clean-closed. "
+        "ALL PROBES GREEN"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,9 +13,13 @@ MEDIAN ratio: the 2-core builder box shows ±25% run-to-run wall noise
 (PERF.md r6) and a single descheduled sweep drags a mean.
 
 The number this prints is the one PERF.md records against the <2%
-target (ISSUE 4 acceptance). Run on CPU::
+target (ISSUE 4 acceptance). ``--recorder`` measures the live
+telemetry plane's marginal cost instead (obs-on vs obs-on + mmap
+flight ring + series flusher at the production cadence — ISSUE 11
+acceptance: within the null floor). Run on CPU::
 
     JAX_PLATFORMS=cpu python scripts/measure_obs_overhead.py
+    JAX_PLATFORMS=cpu python scripts/measure_obs_overhead.py --recorder
 """
 from __future__ import annotations
 
@@ -125,21 +129,57 @@ def steady_sweep_s(result) -> list[float]:
     ]
 
 
-def measure(est, data, rounds: int, null: bool) -> dict:
+def measure(est, data, rounds: int, null: bool, recorder: bool = False) -> dict:
     """ABBA-counterbalanced off/on measurement over an already-warmed
-    problem. ``null=True`` keeps telemetry off in BOTH arms — the
-    reported "overhead" is then the harness' noise floor on this
-    machine."""
+    problem. ``null=True`` keeps the arms IDENTICAL — the reported
+    "overhead" is then the harness' noise floor on this machine.
+
+    ``recorder=True`` measures the flight recorder + series flusher
+    instead of the spine itself: telemetry is enabled in BOTH arms (the
+    recorder rides on an enabled pipeline in production — ``run_profile``
+    turns both on together), and the "on" arm additionally runs the
+    live plane EXACTLY as ``run_profile`` arms it — the mmap ring
+    recorder (every hot-path tap fires) plus the series flusher at its
+    production cadence (``PHOTON_OBS_FLUSH_S``, default 10 s). The
+    flusher's per-flush cost is bounded separately and deterministically
+    (one registry snapshot + one JSONL line, microseconds — PERF.md
+    records a stressed 4 Hz A/B alongside); cadence is an operator
+    knob, so the gated arm measures the shipped default."""
+    import tempfile
+
     from photon_tpu import obs
 
+    ring_dir = tempfile.mkdtemp(prefix="obs-ring-") if recorder else None
     walls: dict[str, list[float]] = {"off": [], "on": []}
     for rnd in range(rounds):
         order = ("off", "on") if rnd % 2 == 0 else ("on", "off")
         for mode in order:
             obs.reset()
-            enable = mode == "on" and not null
-            (obs.enable if enable else obs.disable)()
-            result = est.fit(data)[0]
+            live = mode == "on" and not null
+            if recorder:
+                from photon_tpu.obs import flight
+                from photon_tpu.obs.series import SeriesFlusher, flush_interval_s
+
+                obs.enable()
+                flusher = None
+                if live:
+                    flight.enable(ring_dir)
+                    interval = flush_interval_s()
+                    if interval > 0:  # 0 = flusher disabled, ring only
+                        flusher = SeriesFlusher(
+                            os.path.join(ring_dir, "series.jsonl"),
+                            interval,
+                        ).start()
+                try:
+                    result = est.fit(data)[0]
+                finally:
+                    if flusher is not None:
+                        flusher.stop()
+                    if live:
+                        flight.disable()
+            else:
+                (obs.enable if live else obs.disable)()
+                result = est.fit(data)[0]
             walls[mode].extend(steady_sweep_s(result))
     obs.disable()
 
@@ -147,8 +187,17 @@ def measure(est, data, rounds: int, null: bool) -> dict:
     med_on = statistics.median(walls["on"])
     mean_off = statistics.mean(walls["off"])
     mean_on = statistics.mean(walls["on"])
+    if recorder:
+        mode_label = (
+            "null (obs-on vs obs-on)"
+            if null
+            else "recorder (obs-on vs obs-on + ring + flusher "
+            "@production cadence)"
+        )
+    else:
+        mode_label = "null (off vs off)" if null else "off vs on"
     return {
-        "mode": "null (off vs off)" if null else "off vs on",
+        "mode": mode_label,
         "shape": "config-5 CPU smoke (n=8192, sparse FE 1024, user RE 1024, "
         "item RE 256)",
         "steady_sweeps_per_arm": len(walls["off"]),
@@ -174,6 +223,15 @@ def main(argv=None) -> int:
         "reports is the harness' noise floor on this machine",
     )
     ap.add_argument(
+        "--recorder",
+        action="store_true",
+        help="measure the live telemetry plane's MARGINAL cost instead "
+        "of the spine's: obs enabled in both arms, the 'on' arm adds "
+        "the mmap flight recorder + the series flusher at its "
+        "production cadence (the null calibration then runs obs-on in "
+        "both arms)",
+    )
+    ap.add_argument(
         "--json",
         default=None,
         metavar="PATH",
@@ -193,17 +251,30 @@ def main(argv=None) -> int:
     est.fit(data)  # warmup: persistent-cache path, numpy buffers touched
 
     if args.json:
-        null_report = measure(est, data, args.rounds, null=True)
+        null_report = measure(
+            est, data, args.rounds, null=True, recorder=args.recorder
+        )
         # the real arm is ALWAYS real here: the null calibration above is
         # already the off-vs-off run, and honoring --null would write an
         # artifact whose "overhead" and verdict compare noise to noise
-        report = measure(est, data, args.rounds, null=False)
+        report = measure(
+            est, data, args.rounds, null=False, recorder=args.recorder
+        )
         floor = abs(null_report["overhead_pct"])
         overhead = report["overhead_pct"]
-        verdict = (
-            "within_noise_floor" if abs(overhead) <= floor
-            else "exceeds_noise_floor"
-        )
+        # one-sided cost gate: the hypothesis under test is "the
+        # instrumentation ADDS cost", so only overhead ABOVE the floor
+        # is evidence against it. A reading below -floor cannot mean
+        # telemetry sped the fit up — it means block-to-block machine
+        # drift exceeded what the (single-block) null run estimated, and
+        # it gets its own verdict instead of masquerading as either a
+        # pass or a regression.
+        if overhead > floor:
+            verdict = "exceeds_noise_floor"
+        elif overhead >= -floor:
+            verdict = "within_noise_floor"
+        else:
+            verdict = "no_added_cost_drift_below_floor"
         result = {
             **report,
             "null_floor_pct": floor,
@@ -219,7 +290,9 @@ def main(argv=None) -> int:
         )
         return 0
 
-    report = measure(est, data, args.rounds, null=args.null)
+    report = measure(
+        est, data, args.rounds, null=args.null, recorder=args.recorder
+    )
     print("OBS_OVERHEAD_JSON: " + json.dumps(report))
     print(
         f"telemetry-on median steady sweep "

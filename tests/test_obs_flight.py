@@ -1,0 +1,692 @@
+"""Flight recorder + series flusher tests (ISSUE 11 live telemetry plane).
+
+Covers the crash-surviving mmap ring (append/read round trip, wrap
+eviction, torn-tail skip, clean-close semantics), SIGKILL survival in a
+real subprocess, stale-ring recovery into blackbox-<seq>.json, crash
+handler dumps, the recorder taps' dispatch/read-back neutrality and
+transfer-sanitizer cleanliness, the time-resolved series flusher, and
+``run_profile``'s failure-path partial export (the "crashed runs are
+not telemetry-free" satellite).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu import obs
+from photon_tpu.cli import game_base
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator
+from photon_tpu.obs import flight, series
+from photon_tpu.obs.flight import FlightRecorder
+from photon_tpu.obs.series import SeriesFlusher, read_series
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.types import TaskType
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with the whole live plane torn down
+    and the spine off (other suites rely on telemetry being a no-op)."""
+    obs.reset()
+    obs.disable()
+    flight.disable()
+    flight.uninstall_crash_handler()
+    series.stop_flusher()
+    yield
+    series.stop_flusher()
+    flight.uninstall_crash_handler()
+    flight.disable()
+    obs.reset()
+    obs.disable()
+
+
+def _opt(max_iterations=4):
+    return GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        optimizer_config=OptimizerConfig(max_iterations=max_iterations),
+    )
+
+
+def _small_fit(seed=3, n=300, users=24, d_fe=5, d_re=3, sweeps=2, **est_kw):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, users, size=n)
+    x = rng.normal(size=(n, d_fe))
+    xr = rng.normal(size=(n, d_re))
+    y = x @ rng.normal(size=d_fe) * 0.3 + rng.normal(size=n) * 0.1
+    data = GameData.build(
+        labels=y,
+        feature_shards={
+            "g": CSRMatrix.from_dense(x),
+            "u": CSRMatrix.from_dense(xr),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="g",
+                optimization=_opt(),
+                regularization_weights=(1.0,),
+            ),
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="u",
+                optimization=_opt(),
+                regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["fixed", "user"],
+        descent_iterations=sweeps,
+        seed=seed,
+        **est_kw,
+    )
+    return est, data
+
+
+# -- ring units -------------------------------------------------------------
+
+
+def test_ring_append_read_round_trip(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "r.ring"), capacity_bytes=8192)
+    for i in range(7):
+        assert rec.append("sweep", {"iteration": i}) == i
+    got = rec.records()
+    assert [r["seq"] for r in got] == list(range(7))
+    assert [r["iteration"] for r in got] == list(range(7))
+    assert all(r["k"] == "sweep" and "t_s" in r for r in got)
+    rec.close()
+
+
+def test_ring_wraparound_evicts_oldest_keeps_order(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "r.ring"), capacity_bytes=4096)
+    n = 300
+    for i in range(n):
+        rec.append("sweep", {"iteration": i, "pad": "x" * 40})
+    got = rec.records()
+    seqs = [r["seq"] for r in got]
+    # only the most recent survive, in order, ending at the last append
+    assert 0 < len(got) < n
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == n - 1
+    rec.close()
+
+
+def test_torn_tail_skipped_not_crashed(tmp_path):
+    path = str(tmp_path / "r.ring")
+    rec = FlightRecorder(path, capacity_bytes=8192)
+    for i in range(4):
+        rec.append("sweep", {"iteration": i})
+    rec.close(clean=False)
+    raw = bytearray(open(path, "rb").read())
+    # corrupt the LAST frame's payload: the torn-tail shape a mid-write
+    # kill leaves behind
+    idx = raw.rfind(b"\xabFR1")
+    raw[idx + 24] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    records, clean = FlightRecorder.read_file(path)
+    assert not clean
+    assert [r["iteration"] for r in records] == [0, 1, 2]  # tail skipped
+
+
+def test_oversize_record_dropped_not_crashed(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "r.ring"), capacity_bytes=4096)
+    assert rec.append("huge", {"pad": "x" * 10000}) == -1
+    assert rec.dropped == 1
+    assert rec.append("ok", {}) >= 0
+    assert [r["k"] for r in rec.records()] == ["ok"]
+    rec.close()
+
+
+def test_record_is_noop_without_recorder():
+    flight.record("sweep", iteration=0)  # must not raise or record
+    assert flight.get_recorder() is None
+    assert obs.get_registry().snapshot()["counters"] == {}
+
+
+def test_clean_close_suppresses_recovery(tmp_path):
+    flight.enable(str(tmp_path), capacity_bytes=8192)
+    flight.record("sweep", iteration=0)
+    flight.disable(clean=True)
+    assert flight.recover_stale(str(tmp_path)) is None
+    assert not list(tmp_path.glob("blackbox-*.json"))
+
+
+def test_recover_stale_reports_last_sweep_coordinate_health(tmp_path):
+    flight.enable(str(tmp_path), capacity_bytes=8192)
+    health = {"fixed": {"loss": 1.25, "gnorm": 0.5, "finite": True}}
+    flight.record("fit", task="LINEAR_REGRESSION")
+    flight.record("coordinate", iteration=0, coordinate="fixed")
+    flight.record("sweep", iteration=0, health=health)
+    flight.record("coordinate", iteration=1, coordinate="user")
+    flight.disable(clean=False)  # simulated abrupt death
+
+    out = flight.recover_stale(str(tmp_path))
+    assert out is not None and os.path.exists(out)
+    doc = json.load(open(out))
+    assert doc["recovered"] is True
+    assert doc["last_sweep"]["iteration"] == 0
+    assert doc["last_sweep"]["health"] == health
+    assert doc["last_health"] == health
+    assert doc["last_coordinate"]["coordinate"] == "user"
+    assert len(doc["records"]) == 4
+
+
+def test_ring_survives_real_sigkill(tmp_path):
+    """The acceptance mechanism: a subprocess SIGKILLs ITSELF mid-write
+    loop; the kernel keeps the dirty mmap pages, so the parent reads
+    the dead process's records and recovers a blackbox."""
+    script = f"""
+import os, signal, sys
+sys.path.insert(0, {REPO_ROOT!r})
+from photon_tpu.obs import flight
+flight.enable({str(tmp_path)!r}, capacity_bytes=8192)
+flight.record("coordinate", iteration=0, coordinate="fixed")
+flight.record(
+    "sweep", iteration=0,
+    health={{"fixed": {{"loss": 2.0, "gnorm": 0.1, "finite": True}}}},
+)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    out = flight.recover_stale(str(tmp_path))
+    assert out is not None
+    doc = json.load(open(out))
+    assert doc["recovered"] is True
+    assert doc["last_sweep"]["iteration"] == 0
+    assert doc["last_sweep"]["health"]["fixed"]["loss"] == 2.0
+    assert doc["last_coordinate"]["coordinate"] == "fixed"
+
+
+def test_crash_handler_dumps_on_unhandled_exception(tmp_path):
+    flight.enable(str(tmp_path), capacity_bytes=8192)
+    flight.record("sweep", iteration=3)
+    prev_hook = sys.excepthook
+    flight.install_crash_handler()
+    try:
+        assert sys.excepthook is not prev_hook
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        flight.uninstall_crash_handler()
+    assert sys.excepthook is prev_hook  # chain restored
+    dumps = list(tmp_path.glob("blackbox-*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["recovered"] is False
+    assert "ValueError" in doc["reason"]
+    assert doc["last_sweep"]["iteration"] == 3
+
+
+# -- recorder taps ----------------------------------------------------------
+
+
+def test_recorder_taps_during_fit(tmp_path):
+    obs.enable()
+    flight.enable(str(tmp_path), capacity_bytes=1 << 20)
+    est, data = _small_fit(sweeps=2)
+    est.fit(data)
+    records = flight.get_recorder().records()
+    kinds = [r["k"] for r in records]
+    assert kinds.count("fit") == 1
+    assert kinds.count("grid") == 1
+    assert kinds.count("sweep") == 2
+    assert kinds.count("coordinate") == 4  # 2 coordinates x 2 sweeps
+    sweep = [r for r in records if r["k"] == "sweep"][-1]
+    assert set(sweep["health"]) == {"fixed", "user"}
+    assert all(h["finite"] for h in sweep["health"].values())
+    assert sweep["dispatches"] >= 1
+    assert flight.last_health() == sweep["health"]
+    # taps bump the gated counter (part of the obs-regression shape)
+    counters = obs.get_registry().snapshot()["counters"]
+    assert counters["recorder.records"] == len(records)
+
+
+def test_recorder_is_dispatch_and_readback_neutral(tmp_path, monkeypatch):
+    """Acceptance: the recorder + taps must not change the run's device
+    profile — identical dispatches per steady sweep and identical
+    read-back counts with the ring on vs off (obs enabled both ways,
+    the same A/B method as PRs 4/7/10)."""
+    import photon_tpu.game.descent as descent_mod
+
+    forces = {"n": 0}
+    real_force = descent_mod.force
+    real_fetch = descent_mod.fetch_scalars
+
+    def counting_force(*a, **kw):
+        forces["n"] += 1
+        return real_force(*a, **kw)
+
+    def counting_fetch(*a, **kw):
+        forces["n"] += 1
+        return real_fetch(*a, **kw)
+
+    monkeypatch.setattr(descent_mod, "force", counting_force)
+    monkeypatch.setattr(descent_mod, "fetch_scalars", counting_fetch)
+
+    def run(recorder_on):
+        obs.reset()
+        obs.enable()
+        if recorder_on:
+            flight.enable(str(tmp_path), capacity_bytes=1 << 20)
+        else:
+            flight.disable()
+        est, data = _small_fit(sweeps=3)
+        forces["n"] = 0
+        result = est.fit(data)[0]
+        rows = [
+            r["dispatches"] for r in result.tracker if "sweep_seconds" in r
+        ]
+        return rows, forces["n"]
+
+    rows_off, forces_off = run(recorder_on=False)
+    rows_on, forces_on = run(recorder_on=True)
+    assert rows_on == rows_off
+    assert forces_on == forces_off
+    assert len(rows_off) == 3 and all(d >= 1 for d in rows_off)
+
+
+def test_recorder_taps_clean_under_transfer_sanitizer(tmp_path, monkeypatch):
+    """photon-lint satellite: the hot-path taps read only host values
+    the barrier already fetched — a fit with the ring + sanitizer both
+    armed must not trip ``jax.transfer_guard('disallow')``."""
+    monkeypatch.setenv("PHOTON_SANITIZE", "transfers")
+    obs.enable()
+    flight.enable(str(tmp_path), capacity_bytes=1 << 20)
+    est, data = _small_fit(sweeps=2)
+    est.fit(data)  # raises on any unsanctioned transfer
+    kinds = {r["k"] for r in flight.get_recorder().records()}
+    assert {"fit", "sweep", "coordinate"} <= kinds
+
+
+# -- series flusher ---------------------------------------------------------
+
+
+def test_flush_once_writes_delta_rows(tmp_path):
+    obs.enable()
+    path = str(tmp_path / "series.jsonl")
+    f = SeriesFlusher(path, 60.0)
+    obs.counter("score.samples", 128)
+    obs.gauge("health.loss.fixed", 0.5)
+    obs.histogram("score.batch_seconds", 0.02)
+    f.flush_once()
+    obs.counter("score.samples", 64)
+    f.flush_once()
+    rows = read_series(path)
+    assert len(rows) == 2
+    assert rows[0]["counters"]["score.samples"] == 128
+    assert rows[1]["counters"]["score.samples"] == 64  # DELTA, not total
+    assert rows[0]["gauges"]["health.loss.fixed"] == 0.5
+    assert rows[0]["histograms"]["score.batch_seconds"]["count"] == 1
+    assert rows[1]["histograms"]["score.batch_seconds"]["count"] == 0
+    assert rows[1]["row"] == 1 and rows[1]["t_s"] > rows[0]["t_s"] >= 0
+    counters = obs.get_registry().snapshot()["counters"]
+    assert counters["obs.flush.rows"] == 2
+
+
+def test_flusher_thread_periodic_plus_final_row(tmp_path):
+    obs.enable()
+    path = str(tmp_path / "series.jsonl")
+    f = SeriesFlusher(path, 0.05).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while f.rows_written < 2 and time.monotonic() < deadline:
+            obs.counter("io.records", 10)
+            time.sleep(0.01)
+    finally:
+        f.stop()  # joins + writes the final row
+    rows = read_series(path)
+    assert len(rows) >= 3  # >=2 periodic + 1 final
+    assert rows[-1]["row"] == len(rows) - 1
+    assert f.last_flush_age_s() < 5.0
+
+
+def test_flusher_write_failure_counted_not_raised(tmp_path):
+    obs.enable()
+    f = SeriesFlusher(str(tmp_path), 60.0)  # a DIRECTORY: open() fails
+    assert f.flush_once() is None
+    assert f.errors == 1
+    counters = obs.get_registry().snapshot()["counters"]
+    assert counters["obs.flush.errors"] == 1
+    assert "obs.flush.rows" not in counters
+
+
+def test_flusher_mirrors_rows_into_ring(tmp_path):
+    obs.enable()
+    flight.enable(str(tmp_path), capacity_bytes=8192)
+    f = SeriesFlusher(str(tmp_path / "series.jsonl"), 60.0)
+    obs.counter("descent.sweeps", 2)
+    f.flush_once()
+    recs = [
+        r for r in flight.get_recorder().records() if r["k"] == "metrics"
+    ]
+    assert len(recs) == 1
+    assert recs[0]["counters"]["descent.sweeps"] == 2
+
+
+def test_read_series_skips_truncated_tail(tmp_path):
+    path = str(tmp_path / "series.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "series", "row": 0}) + "\n")
+        f.write('{"kind": "series", "row": 1, "trunc')  # crash mid-write
+    rows = read_series(path)
+    assert [r["row"] for r in rows] == [0]
+
+
+def test_env_knob_validation(monkeypatch):
+    monkeypatch.setenv("PHOTON_OBS_FLUSH_S", "2.5")
+    assert series.flush_interval_s() == 2.5
+    monkeypatch.setenv("PHOTON_OBS_FLUSH_S", "nope")
+    with pytest.raises(ValueError, match="PHOTON_OBS_FLUSH_S"):
+        series.flush_interval_s()
+    monkeypatch.setenv("PHOTON_OBS_RING_MB", "0.5")
+    assert flight.ring_mb() == 0.5
+    monkeypatch.setenv("PHOTON_OBS_RING_MB", "-1")
+    with pytest.raises(ValueError, match="PHOTON_OBS_RING_MB"):
+        flight.ring_mb()
+
+
+def test_ring_mb_zero_disables_recorder(tmp_path, monkeypatch):
+    monkeypatch.setenv("PHOTON_OBS_RING_MB", "0")
+    assert flight.enable(str(tmp_path)) is None
+    assert flight.get_recorder() is None
+    assert not (tmp_path / "blackbox.ring").exists()
+
+
+# -- run_profile integration ------------------------------------------------
+
+
+def test_run_profile_arms_and_cleanly_closes_the_plane(tmp_path, monkeypatch):
+    monkeypatch.setenv("PHOTON_OBS_FLUSH_S", "60")
+    est, data = _small_fit(sweeps=2)
+    with game_base.run_profile(str(tmp_path)):
+        est.fit(data)
+        assert flight.get_recorder() is not None
+        assert series.get_flusher() is not None
+    # plane fully torn down on exit
+    assert flight.get_recorder() is None
+    assert series.get_flusher() is None
+    records, clean = FlightRecorder.read_file(
+        str(tmp_path / "obs" / "blackbox.ring")
+    )
+    assert clean
+    assert "sweep" in {r["k"] for r in records}
+    rows = read_series(str(tmp_path / "obs" / "series.jsonl"))
+    assert rows and rows[-1]["counters"].get("descent.sweeps", 0) >= 1
+
+
+def test_run_profile_failure_exports_partial_artifacts(tmp_path, monkeypatch):
+    """Satellite: a failed run writes best-effort partial metrics +
+    summary + manifest AND a blackbox dump before the exception
+    propagates — crashed runs are not telemetry-free."""
+    monkeypatch.setenv("PHOTON_OBS_FLUSH_S", "60")
+    est, data = _small_fit(sweeps=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        with game_base.run_profile(str(tmp_path)):
+            est.fit(data)
+            raise RuntimeError("boom")
+    obs_dir = tmp_path / "obs"
+    metrics = json.load(open(obs_dir / "partial.metrics.json"))
+    assert metrics["failed"] is True and "boom" in metrics["error"]
+    assert metrics["metrics"]["counters"]["descent.sweeps"] == 2
+    assert (obs_dir / "partial.summary.txt").read_text().strip()
+    assert (obs_dir / "partial.manifest.jsonl").exists()
+    dumps = list(obs_dir.glob("blackbox-*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert "RuntimeError" in doc["reason"]
+    assert doc["last_sweep"]["iteration"] == 1
+
+
+def test_run_profile_recovers_stale_ring_from_dead_run(tmp_path, monkeypatch):
+    """The relaunch half of the SIGKILL acceptance, at the driver
+    seam: a stale (not clean-closed) ring under <out>/obs/ becomes a
+    recovered blackbox-<seq>.json when the next run starts."""
+    monkeypatch.setenv("PHOTON_OBS_FLUSH_S", "0")
+    obs_dir = str(tmp_path / "obs")
+    flight.enable(obs_dir, capacity_bytes=8192)
+    flight.record("coordinate", iteration=1, coordinate="user")
+    flight.record(
+        "sweep", iteration=1,
+        health={"user": {"loss": 1.0, "gnorm": 0.2, "finite": True}},
+    )
+    flight.disable(clean=False)  # the "SIGKILL" — no clean marker
+    with game_base.run_profile(str(tmp_path)):
+        pass
+    dumps = sorted((tmp_path / "obs").glob("blackbox-*.json"))
+    assert dumps
+    doc = json.load(open(dumps[-1]))
+    assert doc["recovered"] is True
+    assert doc["last_sweep"]["iteration"] == 1
+    assert doc["last_coordinate"]["coordinate"] == "user"
+
+
+def test_crash_dump_while_holding_recorder_and_registry_locks(tmp_path):
+    """Signal-path reentrancy: the SIGTERM handler runs on the main
+    thread BETWEEN bytecodes, possibly while that thread already holds
+    the recorder's or the registry's lock (a tap or counter bump was in
+    flight). The dump must still complete — with plain Locks it would
+    deadlock the dying process instead of letting it terminate."""
+    import threading
+
+    obs.enable()
+    rec = flight.enable(str(tmp_path), capacity_bytes=8192)
+    flight.record("sweep", iteration=0)
+    done = {}
+
+    def dump_under_locks():
+        # same-thread re-entry: exactly what a signal landing inside
+        # append()/counter() produces
+        with rec._lock, obs.get_registry()._lock:
+            done["path"] = flight.dump_blackbox("SIGTERM-sim")
+
+    t = threading.Thread(target=dump_under_locks, daemon=True)
+    try:
+        t.start()
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "dump deadlocked on a held lock"
+    finally:
+        done.setdefault("path", None)
+    assert done["path"] is not None
+    assert json.load(open(done["path"]))["last_sweep"]["iteration"] == 0
+
+
+def test_flusher_stop_skips_final_flush_when_thread_wedged(tmp_path):
+    """A flusher thread wedged in an uninterruptible write holds the
+    flush lock past the join timeout; stop() must detach WITHOUT
+    blocking on that same lock for the final row."""
+    import threading
+
+    obs.enable()
+    f = SeriesFlusher(str(tmp_path / "s.jsonl"), 60.0)
+    release = threading.Event()
+
+    def wedge():
+        with f._lock:
+            release.wait(30.0)
+
+    wedger = threading.Thread(target=wedge, daemon=True)
+    wedger.start()
+    time.sleep(0.05)  # let the wedger take the lock
+    # fake a started-but-stuck flusher thread: stop() joins it (times
+    # out at 5 s because it never exits) and must then SKIP the flush
+    f._thread = wedger
+    t0 = time.monotonic()
+    f.stop()
+    elapsed = time.monotonic() - t0
+    release.set()
+    wedger.join(timeout=10.0)
+    assert elapsed < 10.0  # bounded by the join timeout, not the lock
+    assert f.rows_written == 0  # final flush skipped, not deadlocked
+
+
+def test_recover_stale_never_overwrites_crash_dump(tmp_path):
+    """A SIGTERM'd run can leave BOTH a crash-time dump (rich: live
+    metrics snapshot) and a dirty ring; recovery must write beside it,
+    never replace it."""
+    obs.enable()
+    flight.enable(str(tmp_path), capacity_bytes=8192)
+    flight.record("sweep", iteration=0)
+    crash = flight.dump_blackbox(reason="SIGTERM")
+    flight.disable(clean=False)  # died before the clean close
+    out = flight.recover_stale(str(tmp_path))
+    assert out is not None and out != crash
+    assert out.endswith("-recovered.json")
+    assert json.load(open(crash))["recovered"] is False  # intact
+    assert json.load(open(out))["recovered"] is True
+    # a second relaunch finds both dumps present and skips quietly
+    flight.enable(str(tmp_path), capacity_bytes=8192)
+    flight.record("sweep", iteration=0)
+    flight.disable(clean=False)
+    assert flight.recover_stale(str(tmp_path)) is None
+
+
+def test_live_plane_start_failure_tears_down_and_raises(tmp_path, monkeypatch):
+    """An invalid endpoint knob must fail the arm loudly but leave
+    NOTHING half-installed (recorder, crash handlers, flusher)."""
+    monkeypatch.setenv("PHOTON_OBS_HTTP_PORT", "not-a-port")
+    prev_hook = sys.excepthook
+    with pytest.raises(ValueError, match="PHOTON_OBS_HTTP_PORT"):
+        obs.live_plane(str(tmp_path / "obs"))
+    assert flight.get_recorder() is None
+    assert series.get_flusher() is None
+    assert sys.excepthook is prev_hook  # crash-handler chain unwound
+
+
+def test_flusher_start_with_zero_interval_raises(tmp_path):
+    f = SeriesFlusher(str(tmp_path / "s.jsonl"), 0.0)
+    with pytest.raises(ValueError, match="interval_s > 0"):
+        f.start()  # Event.wait(0) would busy-flush
+    f.flush_once()  # direct single flushes stay fine
+
+
+# -- bench_trend within-run decay gate --------------------------------------
+
+
+def _load_trend():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_trend", os.path.join(REPO_ROOT, "scripts", "bench_trend.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _series_file(tmp_path, rates, metric="score.samples", dt=1.0):
+    path = tmp_path / "run.series.jsonl"
+    with open(path, "w") as f:
+        for i, r in enumerate(rates):
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "series",
+                        "row": i,
+                        "t_s": i * dt,
+                        "interval_s": dt,
+                        "counters": {metric: r * dt},
+                        "gauges": {},
+                        "histograms": {},
+                    }
+                )
+                + "\n"
+            )
+    return str(path)
+
+
+def test_trend_series_gate_passes_flat_run(tmp_path):
+    trend = _load_trend()
+    path = _series_file(tmp_path, [100.0, 98.0, 102.0, 99.0])
+    v = trend.judge_series_file(path, "auto", tolerance=0.5)
+    assert v["status"] == "ok" and v["metric"] == "score.samples"
+    assert v["intervals"] == 4 and 0.9 < v["last_over_peak"] <= 1.0
+    assert len(v["sparkline"]) == 4
+
+
+def test_trend_series_gate_fails_within_run_decay(tmp_path):
+    """The tentpole signal: a run whose throughput decayed 100→20/s
+    averages fine but fails the within-run gate."""
+    trend = _load_trend()
+    path = _series_file(tmp_path, [100.0, 80.0, 50.0, 20.0])
+    v = trend.judge_series_file(path, "score.samples", tolerance=0.5)
+    assert v["status"] == "fail"
+    assert "within-run decay" in "; ".join(v["notes"])
+    # report-only without a tolerance
+    v2 = trend.judge_series_file(path, "score.samples", tolerance=None)
+    assert v2["status"] == "ok" and v2["last_over_peak"] == 0.2
+
+
+def test_trend_series_gate_sees_a_hard_stall_as_zero_rate(tmp_path):
+    """A run that hard-stalls mid-flight (zero work per interval) is
+    the WORST decay: interior zero-delta intervals must read as rate 0
+    — not be filtered out leaving the last healthy rate as 'last' —
+    while leading/trailing zeros (ramp-up, teardown/export) trim."""
+    trend = _load_trend()
+    path = _series_file(
+        tmp_path, [0.0, 100.0, 90.0, 0.0, 0.0, 0.0]
+    )  # ramps, runs, stalls forever
+    v = trend.judge_series_file(path, "score.samples", tolerance=0.5)
+    assert v["status"] == "fail"
+    assert v["last_rate"] == 0.0 and v["last_over_peak"] == 0.0
+    # the leading ramp-up zero trimmed: peak intervals count from work
+    assert v["intervals"] == 5
+
+
+def test_trend_series_gate_report_only_on_short_runs(tmp_path):
+    trend = _load_trend()
+    path = _series_file(tmp_path, [100.0, 10.0])  # 2 points: no trajectory
+    v = trend.judge_series_file(path, "score.samples", tolerance=0.9)
+    assert v["status"] == "ok"
+    assert "report-only" in "; ".join(v["notes"])
+
+
+def test_trend_series_cli_exit_codes(tmp_path):
+    trend = _load_trend()
+    bad = _series_file(tmp_path, [100.0, 80.0, 50.0, 20.0])
+    rc = trend.main(
+        [
+            "--history", str(tmp_path / "nope*.json"),
+            "--series", bad,
+            "--series-tolerance", "0.5",
+        ]
+    )
+    assert rc == 3
+    rc = trend.main(
+        ["--history", str(tmp_path / "nope*.json"), "--series", bad]
+    )
+    assert rc == 0  # report-only without the tolerance
+
+
+def test_run_profile_without_out_root_keeps_legacy_contract():
+    """No out_root → no ring, no flusher, no server: the plain PR 4
+    enable/disable session other tests pin stays exactly as it was."""
+    with game_base.run_profile():
+        assert obs.enabled()
+        assert flight.get_recorder() is None
+        assert series.get_flusher() is None
+    assert not obs.enabled()

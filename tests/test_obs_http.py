@@ -1,0 +1,380 @@
+"""Prometheus exposition + live endpoint tests (ISSUE 11 satellites).
+
+Exposition correctness: metric-name sanitization, counter monotonicity
+across ``MetricsRegistry.clear()``, histogram quantile lines from the
+sparse log buckets, and a committed golden file checked through the
+vendored ``text_string_to_metric_families``-style parser (no new
+dependency). Endpoint behavior: /metrics, /healthz, /blackbox served
+live, an injected divergence (``on_divergence=warn``) and a recovery
+restart visible in /healthz, and PHL003-clean server lifecycle.
+"""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_tpu import obs
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator
+from photon_tpu.obs import MetricsRegistry, flight, http
+from photon_tpu.obs.http import (
+    CounterMonotonicity,
+    TelemetryServer,
+    healthz_snapshot,
+    parse_prometheus_text,
+    prometheus_text,
+    sanitize_metric_name,
+)
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.types import TaskType
+from photon_tpu.util import faults
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "fixtures",
+    "prometheus_golden.txt",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    obs.reset()
+    obs.disable()
+    http.stop_server()
+    flight.disable()
+    faults.clear()
+    yield
+    faults.clear()
+    http.stop_server()
+    flight.disable()
+    obs.reset()
+    obs.disable()
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read()
+
+
+def _golden_registry() -> MetricsRegistry:
+    """Fixed metric population behind the committed golden file: every
+    instrument kind, a dashed name, a leading-digit name, float and int
+    counters, and a histogram with enough spread to give distinct
+    percentile lines."""
+    reg = MetricsRegistry()
+    reg.counter("descent.sweeps", 3)
+    reg.counter("score.samples", 4096)
+    reg.counter("io.bytes", 12345.5)
+    reg.gauge("health.loss.per-user", -1.5)
+    reg.gauge("mem.live_bytes", 1048576)
+    reg.gauge("9weird-name", 2)
+    for i in range(100):
+        reg.histogram("score.batch_seconds", 0.001 * (i + 1))
+    return reg
+
+
+# -- exposition units -------------------------------------------------------
+
+
+def test_sanitize_metric_name():
+    assert sanitize_metric_name("descent.sweeps") == "photon_descent_sweeps"
+    assert (
+        sanitize_metric_name("health.loss.per-user")
+        == "photon_health_loss_per_user"
+    )
+    assert sanitize_metric_name("9weird-name") == "photon_9weird_name"
+    assert sanitize_metric_name("a b/c") == "photon_a_b_c"
+
+
+def test_counter_families_get_total_suffix_and_types():
+    fams = parse_prometheus_text(prometheus_text(_golden_registry().snapshot()))
+    assert fams["photon_descent_sweeps_total"]["type"] == "counter"
+    assert fams["photon_health_loss_per_user"]["type"] == "gauge"
+    assert fams["photon_score_batch_seconds"]["type"] == "summary"
+    (sample,) = fams["photon_descent_sweeps_total"]["samples"]
+    assert sample == ("photon_descent_sweeps_total", {}, 3.0)
+
+
+def test_histogram_quantile_lines_match_registry_percentiles():
+    reg = _golden_registry()
+    fams = parse_prometheus_text(prometheus_text(reg.snapshot()))
+    samples = fams["photon_score_batch_seconds"]["samples"]
+    by_label = {
+        lab.get("quantile"): v for name, lab, v in samples if lab
+    }
+    assert set(by_label) == {"0.5", "0.9", "0.99"}
+    for q, v in by_label.items():
+        assert v == pytest.approx(
+            reg.percentile("score.batch_seconds", 100 * float(q))
+        )
+    flat = {name: v for name, lab, v in samples if not lab}
+    assert flat["photon_score_batch_seconds_count"] == 100
+    assert flat["photon_score_batch_seconds_sum"] == pytest.approx(
+        sum(0.001 * (i + 1) for i in range(100))
+    )
+
+
+def test_counter_monotonic_across_registry_reset():
+    """Satellite: a scraper must see a cumulative counter series even
+    though bench/drivers clear() the registry between runs."""
+    reg = MetricsRegistry()
+    mono = CounterMonotonicity()
+
+    def scrape() -> float:
+        fams = parse_prometheus_text(
+            prometheus_text(reg.snapshot(), monotonic=mono)
+        )
+        (s,) = fams["photon_descent_sweeps_total"]["samples"]
+        return s[2]
+
+    reg.counter("descent.sweeps", 5)
+    values = [scrape()]
+    reg.counter("descent.sweeps", 2)
+    values.append(scrape())
+    reg.clear()  # the reset a plain exposition would render as a drop
+    reg.counter("descent.sweeps", 1)
+    values.append(scrape())
+    reg.clear()
+    reg.counter("descent.sweeps", 0.5)
+    values.append(scrape())
+    assert values == [5, 7, 8, 8.5]
+    assert values == sorted(values)  # never decreases
+
+
+def test_golden_file_schema(tmp_path):
+    """The committed golden exposition must match byte-for-byte AND
+    parse through the vendored parser — the schema check that catches
+    accidental format drift (regenerate deliberately via
+    ``python -m pytest tests/test_obs_http.py -k golden --golden-write``
+    style edits, i.e. rewriting the fixture by hand)."""
+    text = prometheus_text(_golden_registry().snapshot())
+    golden = open(GOLDEN_PATH).read()
+    assert text == golden
+    fams = parse_prometheus_text(golden)
+    assert sorted(fams) == [
+        "photon_9weird_name",
+        "photon_descent_sweeps_total",
+        "photon_health_loss_per_user",
+        "photon_io_bytes_total",
+        "photon_mem_live_bytes",
+        "photon_score_batch_seconds",
+        "photon_score_samples_total",
+    ]
+    # every sample numeric, every family typed
+    for fam in fams.values():
+        assert fam["type"] in ("counter", "gauge", "summary")
+        for name, labels, value in fam["samples"]:
+            assert isinstance(value, float)
+
+
+def test_parser_rejects_malformed_lines():
+    with pytest.raises(ValueError, match="non-numeric value"):
+        parse_prometheus_text("# TYPE photon_x counter\nphoton_x not-a-number")
+    with pytest.raises(ValueError, match="malformed sample"):
+        parse_prometheus_text("# TYPE photon_x counter\n{weird} 3")
+    with pytest.raises(ValueError, match="precedes"):
+        parse_prometheus_text("photon_unknown 3")
+    with pytest.raises(ValueError, match="unknown type"):
+        parse_prometheus_text("# TYPE photon_x wat\nphoton_x 3")
+
+
+def test_nonfinite_gauge_renders_parseable():
+    """A diverged run's NaN/Inf health gauges are exactly when the
+    scrape must keep working — they render as Prometheus NaN/+Inf/-Inf
+    samples, never a 500 (int(inf) raises OverflowError)."""
+    reg = MetricsRegistry()
+    reg.gauge("health.gnorm.fixed", float("nan"))
+    reg.gauge("health.gnorm.user", float("inf"))
+    reg.gauge("health.loss.user", float("-inf"))
+    fams = parse_prometheus_text(prometheus_text(reg.snapshot()))
+    (s,) = fams["photon_health_gnorm_fixed"]["samples"]
+    assert s[2] != s[2]  # NaN round-trips as NaN, not a parse error
+    (s,) = fams["photon_health_gnorm_user"]["samples"]
+    assert s[2] == float("inf")
+    (s,) = fams["photon_health_loss_user"]["samples"]
+    assert s[2] == float("-inf")
+
+
+# -- endpoints --------------------------------------------------------------
+
+
+def test_endpoints_serve_metrics_healthz_blackbox(tmp_path):
+    obs.enable()
+    obs.counter("descent.sweeps", 2)
+    flight.enable(str(tmp_path), capacity_bytes=8192)
+    flight.record("sweep", iteration=0)
+    srv = TelemetryServer(0)
+    port = srv.start()
+    try:
+        fams = parse_prometheus_text(
+            _get(f"http://127.0.0.1:{port}/metrics").decode()
+        )
+        assert "photon_descent_sweeps_total" in fams
+        hz = json.loads(_get(f"http://127.0.0.1:{port}/healthz"))
+        assert hz["status"] == "ok"
+        assert hz["recorder"]["last_seq"] == 0
+        bb = json.loads(_get(f"http://127.0.0.1:{port}/blackbox"))
+        assert [r["k"] for r in bb["records"]] == ["sweep"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://127.0.0.1:{port}/nope")
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+    # PHL003: stopped server has no live thread or socket
+    assert srv._thread is None and srv._httpd is None
+
+
+def test_scrape_is_monotonic_across_obs_reset():
+    obs.enable()
+    obs.counter("io.records", 10)
+    srv = TelemetryServer(0)
+    port = srv.start()
+    try:
+        def sweeps():
+            fams = parse_prometheus_text(
+                _get(f"http://127.0.0.1:{port}/metrics").decode()
+            )
+            (s,) = fams["photon_io_records_total"]["samples"]
+            return s[2]
+
+        def batch_count():
+            fams = parse_prometheus_text(
+                _get(f"http://127.0.0.1:{port}/metrics").decode()
+            )
+            samples = fams["photon_score_batch_seconds"]["samples"]
+            return {n: v for n, lab, v in samples if not lab}[
+                "photon_score_batch_seconds_count"
+            ]
+
+        obs.histogram("score.batch_seconds", 0.01)
+        obs.histogram("score.batch_seconds", 0.02)
+        assert sweeps() == 10
+        assert batch_count() == 2
+        obs.reset()  # the per-run boundary
+        obs.counter("io.records", 3)
+        obs.histogram("score.batch_seconds", 0.03)
+        assert sweeps() == 13  # cumulative, not a sawtooth
+        # summary _count/_sum are cumulative counters in Prometheus
+        # semantics — same reset compensation as plain counters
+        assert batch_count() == 3
+    finally:
+        srv.stop()
+
+
+def test_start_from_env_gating(monkeypatch):
+    monkeypatch.delenv("PHOTON_OBS_HTTP_PORT", raising=False)
+    assert http.start_from_env() is None  # default: no socket at all
+    monkeypatch.setenv("PHOTON_OBS_HTTP_PORT", "not-a-port")
+    with pytest.raises(ValueError, match="PHOTON_OBS_HTTP_PORT"):
+        http.start_from_env()
+    monkeypatch.setenv("PHOTON_OBS_HTTP_PORT", "0")
+    srv = http.start_from_env()
+    try:
+        assert srv is not None and srv.port > 0
+        assert http.start_from_env() is srv  # idempotent while live
+    finally:
+        http.stop_server()
+    assert http.get_server() is None
+
+
+def _divergent_fit(on_divergence):
+    """A 2-coordinate fit whose 'user' coordinate gets NaN-poisoned by
+    the chaos plan before its first step — the health monitor flags it
+    at the first sweep barrier."""
+    rng = np.random.default_rng(5)
+    n, users, d_fe, d_re = 200, 12, 4, 3
+    ids = rng.integers(0, users, size=n)
+    x = rng.normal(size=(n, d_fe))
+    xr = rng.normal(size=(n, d_re))
+    y = x @ rng.normal(size=d_fe) * 0.3 + rng.normal(size=n) * 0.1
+    data = GameData.build(
+        labels=y,
+        feature_shards={
+            "g": CSRMatrix.from_dense(x),
+            "u": CSRMatrix.from_dense(xr),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+    opt = GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        optimizer_config=OptimizerConfig(max_iterations=3),
+    )
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="g", optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="userId", feature_shard="u",
+                optimization=opt, regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["fixed", "user"],
+        descent_iterations=2,
+        seed=5,
+        on_divergence=on_divergence,
+    )
+    return est, data
+
+
+def test_healthz_reflects_injected_divergence_and_recovery_restart(tmp_path):
+    """Acceptance: /healthz flips to 'diverged' after an injected NaN
+    under on_divergence=warn, names the non-finite coordinate, and
+    shows a recovery restart — all live (registry-read, no flush
+    needed, so 'within one flush interval' holds trivially)."""
+    obs.enable()
+    flight.enable(str(tmp_path), capacity_bytes=1 << 20)
+    srv = TelemetryServer(0)
+    port = srv.start()
+    try:
+        hz = json.loads(_get(f"http://127.0.0.1:{port}/healthz"))
+        assert hz["status"] == "ok" and hz["divergences"] == 0
+
+        faults.install("descent.coordinate@2=nan")  # occurrence 2 = 'user'
+        est, data = _divergent_fit("warn")
+        est.fit(data)
+
+        hz = json.loads(_get(f"http://127.0.0.1:{port}/healthz"))
+        assert hz["status"] == "diverged"
+        assert hz["divergences"] >= 1
+        # the poisoned coordinate reads non-finite in the live health row
+        # (under "warn" the NaN then spreads through the shared residual
+        # total, so by the LAST sweep other coordinates may read
+        # non-finite too — attribution lives in the divergence record)
+        assert hz["health"]["user"]["finite"] is False
+        # blackbox carries the divergence record too
+        bb = json.loads(_get(f"http://127.0.0.1:{port}/blackbox"))
+        div = [r for r in bb["records"] if r["k"] == "divergence"]
+        assert div and div[0]["coordinate"] == "user"
+
+        # a recovery restart (game/recovery.py emits recovery.restarts)
+        # must surface on the next scrape
+        obs.counter("recovery.restarts")
+        obs.counter("recovery.failures.transient")
+        hz = json.loads(_get(f"http://127.0.0.1:{port}/healthz"))
+        assert hz["recovery"]["restarts"] == 1
+        assert hz["recovery"]["failures"] == {"transient": 1.0}
+    finally:
+        srv.stop()
+
+
+def test_healthz_snapshot_without_plane_is_pure_host():
+    doc = healthz_snapshot()
+    assert doc["status"] == "ok"
+    assert doc["recorder"] is None and doc["flusher"] is None
+    json.dumps(doc)  # strictly serializable
