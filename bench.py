@@ -193,6 +193,10 @@ QUALITY_BANDS = {
     "glmix_game_estimator": {
         "grouped_auc_min": {"smoke": 0.55, "cpu": 0.8, "tpu": 0.8},
         "require_memory": True,
+        # feature-cache ingest A/B (ROADMAP 4): a cached replay that is
+        # not wire-identical to the avro read is garbage, not a speedup
+        "cache_parity_max": 1e-6,
+        "cache_warm_decode_spans_max": 0,
     },
     "game_ctr_scale": {
         "grouped_auc_min": {"smoke": 0.55, "cpu": 0.8, "tpu": 0.8},
@@ -205,6 +209,11 @@ QUALITY_BANDS = {
     "game_scoring_stream": {
         "score_parity_rel_max": 1e-3,
         "steady_compiles_max": 0,
+        # the warm mmap replay must be float-identical to the avro-fed
+        # stream (same fused engine, same batch shapes) and must run ZERO
+        # avro-decode spans — the cache's whole claim, obs-pinned
+        "cache_parity_max": 1e-6,
+        "cache_warm_decode_spans_max": 0,
     },
 }
 
@@ -247,6 +256,24 @@ def check_quality_bands(name: str, detail: dict) -> list[str]:
             out.append(
                 f"steady-state scoring compiled {sc} programs "
                 f"(> {steady_max}; retrace leaked into the hot loop)"
+            )
+    cache_parity_max = band.get("cache_parity_max")
+    if cache_parity_max is not None:
+        cache = detail.get("cache") or {}
+        par = cache.get("parity_max_abs")
+        if par is None or not math.isfinite(par) or par > cache_parity_max:
+            out.append(
+                f"feature-cache wire parity {par} > {cache_parity_max} "
+                "(the cached replay differs from the avro read)"
+            )
+    decode_spans_max = band.get("cache_warm_decode_spans_max")
+    if decode_spans_max is not None:
+        wd = (detail.get("cache") or {}).get("warm_decode_spans")
+        if wd is None or wd > decode_spans_max:
+            out.append(
+                f"warm cache run emitted {wd} io.decode span(s) "
+                f"(> {decode_spans_max}; avro decode leaked into the "
+                "warm path)"
             )
     if band.get("require_memory"):
         mem = detail.get("mem") or {}
@@ -1031,6 +1058,168 @@ def _game_examples_from_tracker(tracker, datasets, n_real):
     return per_coord
 
 
+def _pin_cache_env():
+    """Pop ambient PHOTON_FEATURE_CACHE* env for the duration of a cache
+    A/B (returns the saved dict to restore): the A/B passes its modes
+    explicitly, and the knob convention is env-wins — an exported
+    ``require`` would kill the cold leg against a fresh tempdir, an
+    exported ``off`` would run both legs on avro and fail the
+    warm-decode band with a misleading message (the same hazard
+    scripts/check_obs_regression.py pins out of its canonical leg)."""
+    return {
+        k: os.environ.pop(k)
+        for k in list(os.environ)
+        if k.startswith("PHOTON_FEATURE_CACHE")
+    }
+
+
+def _cache_ingest_ab(data, max_rows=16384):
+    """Feature-cache cold/warm ingest A/B for a GAME TRAINING dataset
+    (ROADMAP item 4): round-trip ``data`` (capped at ``max_rows`` rows,
+    recorded) through avro part files, then read them back cold
+    (decode + cache build) and warm (mmap replay), asserting column-level
+    wire parity between the two reads. Runs inside the config's obs
+    session using DELTAS (no resets), so the fit telemetry that follows
+    stays intact."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu import obs
+    from photon_tpu.cache import resolve_reader
+    from photon_tpu.data.index_map import DefaultIndexMap, feature_key
+    from photon_tpu.game.data import slice_game_data
+    from photon_tpu.io.avro import write_avro_file
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+    from photon_tpu.obs import phase_summary
+
+    n_ab = int(min(data.num_samples, max_rows))
+    sub = slice_game_data(data, 0, n_ab)
+    shard_names = sorted(sub.feature_shards)
+    tags = sorted(sub.id_tags)
+    d = tempfile.mkdtemp(prefix="bench-cache-ab-")
+    saved_env = _pin_cache_env()
+    try:
+        # one avro bag holds every shard's features, namespaced by shard;
+        # each shard's index map then selects exactly its own columns
+        # back out (keys absent from a shard's map are dropped on read)
+        def records(lo, hi):
+            for i in range(lo, hi):
+                feats = []
+                for s in shard_names:
+                    cols_i, vals_i = sub.feature_shards[s].row(i)
+                    feats.extend(
+                        {
+                            "name": f"{s}:{int(c)}",
+                            "term": "",
+                            "value": float(v),
+                        }
+                        for c, v in zip(cols_i, vals_i)
+                    )
+                yield {
+                    "uid": f"r{i}",
+                    "label": float(sub.labels[i]),
+                    "features": feats,
+                    "metadataMap": {
+                        t: str(sub.id_tags[t][i]) for t in tags
+                    },
+                    "weight": float(sub.weights[i]),
+                    "offset": float(sub.offsets[i]),
+                }
+
+        t0 = time.perf_counter()
+        parts = 4
+        per = (n_ab + parts - 1) // parts
+        for p in range(parts):
+            write_avro_file(
+                os.path.join(d, f"part-{p:05d}.avro"),
+                TRAINING_EXAMPLE_AVRO,
+                records(p * per, min((p + 1) * per, n_ab)),
+            )
+        gen_s = time.perf_counter() - t0
+        shard_configs = {
+            s: FeatureShardConfig(
+                feature_bags=("features",), has_intercept=False
+            )
+            for s in shard_names
+        }
+        index_maps = {
+            s: DefaultIndexMap(
+                {
+                    feature_key(f"{s}:{j}"): j
+                    for j in range(sub.feature_shards[s].num_cols)
+                }
+            )
+            for s in shard_names
+        }
+
+        def decode_count():
+            return int(phase_summary().get("io.decode", {}).get("count", 0))
+
+        def counters():
+            return obs.get_registry().snapshot()["counters"]
+
+        d0, c0 = decode_count(), counters()
+        t1 = time.perf_counter()
+        data_cold = resolve_reader(
+            d, shard_configs, index_maps=index_maps, id_tags=tuple(tags),
+            mode="rebuild",
+        ).read()
+        cold_s = time.perf_counter() - t1
+        d1 = decode_count()
+        t2 = time.perf_counter()
+        data_warm = resolve_reader(
+            d, shard_configs, index_maps=index_maps, id_tags=tuple(tags),
+            mode="require",
+        ).read()
+        warm_s = time.perf_counter() - t2
+        d2, c2 = decode_count(), counters()
+
+        parity = 0.0
+        for a, b in (
+            (data_cold.labels, data_warm.labels),
+            (data_cold.offsets, data_warm.offsets),
+            (data_cold.weights, data_warm.weights),
+        ):
+            if n_ab:
+                parity = max(parity, float(np.max(np.abs(a - b))))
+        for s in shard_names:
+            ma, mb = data_cold.feature_shards[s], data_warm.feature_shards[s]
+            if not (
+                np.array_equal(ma.indptr, mb.indptr)
+                and np.array_equal(ma.indices, mb.indices)
+            ):
+                parity = float("inf")
+            elif len(ma.values):
+                parity = max(
+                    parity, float(np.max(np.abs(ma.values - mb.values)))
+                )
+        for t in tags:
+            if list(data_cold.id_tags[t]) != list(data_warm.id_tags[t]):
+                parity = float("inf")
+        return {
+            "rows": n_ab,
+            "avro_gen_s": round(gen_s, 3),
+            "cold_ingest_s": round(cold_s, 4),  # decode + cache build
+            "warm_ingest_s": round(warm_s, 4),  # mmap replay
+            "ingest_speedup": round(cold_s / warm_s, 3) if warm_s else None,
+            "parity_max_abs": parity,
+            "warm_hit": int(
+                c2.get("cache.hit", 0) - c0.get("cache.hit", 0)
+            ),
+            "warm_bytes": int(
+                c2.get("cache.bytes", 0) - c0.get("cache.bytes", 0)
+            ),
+            "cold_decode_spans": d1 - d0,
+            "warm_decode_spans": d2 - d1,
+        }
+    finally:
+        os.environ.update(saved_env)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _run_game_config(
     *,
     n,
@@ -1042,6 +1231,7 @@ def _run_game_config(
     re_max_iter,
     seed=0,
     config_name="game",
+    cache_ingest_ab=False,
 ):
     """Build skewed GAME data and run GameEstimator.fit; returns detail dict.
 
@@ -1157,6 +1347,11 @@ def _run_game_config(
     )
     data_build_s = time.perf_counter() - t0
     _log(f"[bench] game data build {data_build_s:.1f}s (n={n})")
+
+    cache_detail = None
+    if cache_ingest_ab:
+        cache_detail = _cache_ingest_ab(data)
+        _log(f"[bench] feature-cache ingest A/B: {cache_detail}")
 
     update_seq = ["fixed"] + [name for name, *_ in coords_spec]
     est = GameEstimator(
@@ -1416,6 +1611,7 @@ def _run_game_config(
         "value_entropy": value_entropy,
         "obs": obs_detail,
         "mem": mem_detail,
+        "cache": cache_detail,
         "fe_layout": "sparse_ell" if fe_nnz < fe_dim else "dense",
         "coordinates": {
             name: {"num_entities": ne, "d_re": dr, "active_upper_bound": ub}
@@ -1486,6 +1682,9 @@ def config_glmix_estimator(peak_flops, scale):
         fe_max_iter=_pick(scale, 5, 20, 20),
         re_max_iter=_pick(scale, 3, 10, 10),
         config_name="glmix_game_estimator",
+        # the feature-cache cold/warm ingest A/B rides the GLMix config:
+        # training pays the same decode+assembly every run (ROADMAP 4)
+        cache_ingest_ab=True,
     )
 
 
@@ -1692,12 +1891,15 @@ def config_scoring_stream(peak_flops, scale):
 
         counter = {"s": 0, "m": 0}
 
-        def run_stream():
-            reader = AvroDataReader(index_maps={"global": imap})
-            chunks = reader.iter_chunks(
-                in_dir, shard_configs, id_tags=("userId", "itemId"),
-                chunk_rows=batch_rows,
-            )
+        def run_stream(chunk_source=None):
+            if chunk_source is None:
+                reader = AvroDataReader(index_maps={"global": imap})
+                chunks = reader.iter_chunks(
+                    in_dir, shard_configs, id_tags=("userId", "itemId"),
+                    chunk_rows=batch_rows,
+                )
+            else:
+                chunks = chunk_source()
             sdir = os.path.join(out_root, f"stream-{counter['s']}")
             counter["s"] += 1
             writer = ShardedScoringWriter(
@@ -1786,6 +1988,57 @@ def config_scoring_stream(peak_flops, scale):
         obs.reset()
         m2_scores, m2_wall = run_mono()
 
+        # --- feature-cache cold/warm ingest A/B (ROADMAP item 4) -------
+        # cold: decode avro once while BUILDING the columnar cache
+        # through the same stream; warm: replay the mmap cache (the
+        # producer becomes mmap slice + H2D copy). Same fused engine on
+        # both sides, so wire-parity is exact-float and the speedup is
+        # pure ingest. io.decode span counts are recorded per side — the
+        # warm side must show ZERO (quality-band gated).
+        from photon_tpu.cache import resolve_reader
+        from photon_tpu.obs import phase_summary as _cache_phases
+
+        def run_cache_stream(mode):
+            # the wall INCLUDES resolve_reader — open, column size
+            # checks, and the source-file sha256 re-hash are what a real
+            # warm driver run pays before its first chunk, so excluding
+            # them would overstate the warm win (the glmix ingest A/B
+            # times the same way)
+            t0 = time.perf_counter()
+            resolved = resolve_reader(
+                in_dir,
+                shard_configs,
+                index_maps={"global": imap},
+                id_tags=("userId", "itemId"),
+                mode=mode,
+            )
+            res, _ = run_stream(
+                chunk_source=lambda: resolved.iter_chunks(
+                    chunk_rows=batch_rows
+                )
+            )
+            return res, time.perf_counter() - t0
+
+        saved_cache_env = _pin_cache_env()
+        obs.reset()
+        obs.enable()
+        try:
+            s_cold, cache_cold_wall = run_cache_stream("rebuild")
+            cold_decode_spans = int(
+                _cache_phases().get("io.decode", {}).get("count", 0)
+            )
+            obs.reset()
+            s_warm, cache_warm_wall = run_cache_stream("require")
+            warm_decode_spans = int(
+                _cache_phases().get("io.decode", {}).get("count", 0)
+            )
+            cache_counters = obs.get_registry().snapshot()["counters"]
+        finally:
+            os.environ.update(saved_cache_env)
+            obs.disable()
+            obs.reset()
+        cache_warm_sps = n / cache_warm_wall
+
         denom = 1.0 + np.abs(m2_scores)
         max_abs = float(np.max(np.abs(s2.scores - m2_scores)))
         max_rel = float(np.max(np.abs(s2.scores - m2_scores) / denom))
@@ -1834,6 +2087,21 @@ def config_scoring_stream(peak_flops, scale):
             },
             "speedup_vs_monolithic": round(stream_sps / mono_sps, 3),
             "examples_per_sec": round(stream_sps, 1),
+            "cache": {
+                "cold_wall_s": round(cache_cold_wall, 4),
+                "warm_wall_s": round(cache_warm_wall, 4),
+                "warm_samples_per_sec": round(cache_warm_sps, 1),
+                "warm_speedup_vs_avro_stream": round(
+                    cache_warm_sps / stream_sps, 3
+                ),
+                "parity_max_abs": float(
+                    np.max(np.abs(s_warm.scores - s_cold.scores))
+                ),
+                "warm_hit": int(cache_counters.get("cache.hit", 0)),
+                "warm_bytes": int(cache_counters.get("cache.bytes", 0)),
+                "cold_decode_spans": cold_decode_spans,
+                "warm_decode_spans": warm_decode_spans,
+            },
             "obs": obs_detail,
             "mem": mem_detail,
         }

@@ -243,6 +243,7 @@ def all_rules() -> list[Rule]:
         rules_ctypes,
         rules_host_sync,
         rules_jit,
+        rules_mmap,
         rules_retry,
         rules_spmd,
         rules_threads,

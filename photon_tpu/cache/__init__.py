@@ -1,0 +1,442 @@
+"""Packed columnar feature cache: the write-once mmap ingest tier.
+
+PERF.md r8 measured C++ avro decode as THE critical path of streaming
+scoring (~0.2–0.3 s of the ~0.4 s wall), and every training run pays the
+same decode + host assembly again from scratch. This package
+materializes a dataset ONCE into a versioned, memory-mapped columnar
+store (``cache.format`` / ``cache.writer``) and replays it on every
+subsequent fit/score with zero avro decode and zero host assembly
+(``cache.reader``) — the producer thread becomes an mmap slice + H2D
+copy, the Snap ML hierarchical-ingest shape (PAPERS.md).
+
+The FRONT DOOR is :func:`resolve_reader`: call sites hand it what they
+were going to hand ``AvroDataReader`` and get back a reader honoring the
+same ``read`` / ``iter_chunks`` contract, resolved by mode
+(``PHOTON_FEATURE_CACHE`` env > explicit argument > ``off``):
+
+``off``      the avro path, untouched (the default);
+``use``      replay a fresh cache when one exists (``cache.hit``),
+             otherwise read avro AND build the cache opportunistically —
+             run 1 is the cold build, run 2 is warm;
+``rebuild``  force a fresh build even over a valid cache;
+``require``  refuse to run without a fresh cache
+             (:class:`FeatureCacheRequiredError` points at
+             ``scripts/cache_tool.py``) — the production mode where an
+             accidental decode would blow a latency budget.
+
+Degrade discipline: a cache that is missing, torn (size/checksum
+mismatch — ``PHOTON_FEATURE_CACHE_VERIFY=1`` rechecks sha256s at open),
+or stale (source file set / shard configs / id tags / index maps
+changed) falls back to the avro path with a ``cache.fallback`` counter
+and lifecycle event — never to garbage rows. Chaos hooks ``cache.open``
+/ ``cache.read`` / ``cache.write`` / ``cache.replace`` make every leg of
+that discipline deterministically injectable (tests/test_cache.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Iterator, Mapping, Sequence
+
+from photon_tpu import obs
+from photon_tpu.cache.format import (
+    CACHE_FORMAT_VERSION,
+    CacheCorruptError,
+    CacheError,
+    CacheStaleError,
+    FeatureCacheRequiredError,
+    MANIFEST,
+    canonical_json,
+    shard_config_fingerprint,
+)
+from photon_tpu.cache.reader import CachedDataReader
+from photon_tpu.cache.writer import (
+    FeatureCacheWriter,
+    build_through,
+    report_build_failure,
+    write_game_data,
+)
+from photon_tpu.game.data import GameData
+
+__all__ = [
+    "CACHE_FORMAT_VERSION",
+    "CacheCorruptError",
+    "CacheError",
+    "CacheStaleError",
+    "CachedDataReader",
+    "FeatureCacheRequiredError",
+    "FeatureCacheWriter",
+    "MANIFEST",
+    "MODES",
+    "ResolvedReader",
+    "cache_mode",
+    "default_cache_dir",
+    "resolve_reader",
+    "verify_on_open",
+    "write_game_data",
+]
+
+logger = logging.getLogger(__name__)
+
+MODES = ("off", "use", "require", "rebuild")
+
+#: the error classes the front door may absorb into an avro fallback —
+#: everything else (a programming error, an injected crash) propagates
+_DEGRADABLE = (CacheError, OSError, ValueError, KeyError)
+
+
+def cache_mode(config_value: str | None = None) -> str:
+    """Resolve the feature-cache mode: ``PHOTON_FEATURE_CACHE`` env >
+    explicit CLI/config value > ``off`` (the repo's env-over-config knob
+    precedence). Invalid values fail loudly up front."""
+    env = os.environ.get("PHOTON_FEATURE_CACHE", "").strip()
+    v = env or (config_value or "off")
+    if v not in MODES:
+        raise ValueError(
+            f"feature-cache mode must be one of {'/'.join(MODES)}, got {v!r}"
+        )
+    return v
+
+
+def verify_on_open() -> bool:
+    """``PHOTON_FEATURE_CACHE_VERIFY=1`` → recheck every column's sha256
+    at open (O(cache bytes); default off — size checks always run)."""
+    env = os.environ.get("PHOTON_FEATURE_CACHE_VERIFY", "").strip()
+    if env and env not in ("0", "1"):
+        raise ValueError(
+            f"PHOTON_FEATURE_CACHE_VERIFY must be 0 or 1, got {env!r}"
+        )
+    return env == "1"
+
+
+def default_cache_dir(
+    paths: Sequence[str], shard_configs: Mapping, id_tags: Sequence[str]
+) -> str:
+    """Where a dataset's cache lives when no explicit dir is given:
+    ``<cache root>/<key>``, keyed on the schema (shard configs + id tags
+    + format version) and the PATH SET — a different file set gets a
+    different directory (miss → build), while the same paths with
+    changed CONTENT resolve to the same directory and fail the
+    fingerprint (stale → degrade/rebuild). The cache root defaults to
+    ``<data base>/_photon_cache``; ``PHOTON_FEATURE_CACHE_DIR`` relocates
+    the ROOT (the per-dataset key still appends, so one run's several
+    datasets — training + validation — keep separate caches instead of
+    thrashing one directory)."""
+    key_src = canonical_json(
+        {
+            "format_version": CACHE_FORMAT_VERSION,
+            "shard_configs": shard_config_fingerprint(shard_configs),
+            "id_tags": sorted(id_tags),
+            "paths": sorted(os.path.abspath(str(p)) for p in paths),
+        }
+    )
+    key = hashlib.sha256(key_src.encode("utf-8")).hexdigest()[:16]
+    env = os.environ.get("PHOTON_FEATURE_CACHE_DIR", "").strip()
+    if env:
+        return os.path.join(env, key)
+    first = str(paths[0])
+    base = first if os.path.isdir(first) else (os.path.dirname(first) or ".")
+    return os.path.join(base, "_photon_cache", key)
+
+
+def list_source_files(paths: Sequence[str]) -> list[str]:
+    """THE avro part-file enumeration for the cache layer (front door,
+    writer fingerprinting, cache_tool) — one policy site, and resolve
+    captures its result so the staleness verdict and a build-through's
+    written fingerprint describe the SAME file list even if the
+    directory changes mid-run."""
+    from photon_tpu.io.avro import avro_part_files
+
+    return [f for p in paths for f in avro_part_files(p)]
+
+
+def _fallback(reason: str, detail: str) -> None:
+    obs.counter("cache.fallback")
+    obs.instant("cache.fallback", cat="lifecycle", reason=reason, error=detail)
+    logger.warning(
+        "feature cache unusable (%s: %s); degrading to the avro path",
+        reason, detail,
+    )
+
+
+class ResolvedReader:
+    """What :func:`resolve_reader` returns: the ``read`` / ``iter_chunks``
+    contract of ``AvroDataReader``, served from the cache on a hit and
+    from avro (with an opportunistic build-through) otherwise."""
+
+    def __init__(
+        self,
+        *,
+        mode: str,
+        state: str,
+        paths: Sequence[str],
+        shard_configs: Mapping,
+        id_tags: Sequence[str],
+        cache_dir: str | None,
+        cached: CachedDataReader | None,
+        index_maps: Mapping | None,
+        source_files: list | None = None,
+        source_fingerprint: list | None = None,
+    ):
+        self.mode = mode
+        self.state = state  # off | hit | miss | stale | corrupt
+        self.paths = list(paths)
+        self.shard_configs = dict(shard_configs)
+        self.id_tags = tuple(id_tags)
+        self.cache_dir = cache_dir
+        self._cached = cached
+        self._avro = None
+        self._caller_maps = dict(index_maps) if index_maps else None
+        self._source_files_cached = source_files
+        self._source_fingerprint = source_fingerprint
+        self._built = False
+
+    # -- plumbing ---------------------------------------------------------
+
+    @property
+    def source(self) -> str:
+        return "cache" if self._cached is not None else "avro"
+
+    @property
+    def index_maps(self) -> dict:
+        """The maps this dataset resolves features with: the caller's,
+        enriched/generated by an avro read, or the cache's own stored
+        maps on a mapless warm hit."""
+        if self._avro is not None:
+            return self._avro.index_maps
+        if self._caller_maps:
+            return dict(self._caller_maps)
+        if self._cached is not None:
+            return self._cached.index_maps_for(list(self.shard_configs))
+        return {}
+
+    def describe(self) -> dict:
+        return {
+            "mode": self.mode,
+            "source": self.source,
+            "state": self.state,
+            "cacheDir": self.cache_dir,
+        }
+
+    def _avro_reader(self):
+        from photon_tpu.io.data_reader import AvroDataReader
+
+        if self._avro is None:
+            self._avro = AvroDataReader(index_maps=self._caller_maps)
+        return self._avro
+
+    def _source_files(self) -> list[str]:
+        if self._source_files_cached is None:
+            self._source_files_cached = list_source_files(self.paths)
+        return self._source_files_cached
+
+    def _should_build(self) -> bool:
+        return (
+            self.mode in ("use", "rebuild")
+            and self._cached is None
+            and not self._built
+            and self.cache_dir is not None
+        )
+
+    def _degrade(self, stage: str, exc: BaseException) -> None:
+        """Drop a cache that failed mid-use (require mode never degrades:
+        the operator asked for the cache or a loud failure)."""
+        if self.mode == "require":
+            raise FeatureCacheRequiredError(
+                f"feature cache {self.cache_dir} failed during {stage} "
+                f"({type(exc).__name__}: {exc}) and "
+                "PHOTON_FEATURE_CACHE=require forbids the avro fallback; "
+                "rebuild and verify it with scripts/cache_tool.py"
+            ) from exc
+        _fallback(stage, f"{type(exc).__name__}: {exc}")
+        self._cached = None
+        self.state = "corrupt"
+
+    # -- the AvroDataReader contract --------------------------------------
+
+    def read(self) -> GameData:
+        """One GameData for the whole dataset (the monolithic ingest
+        call sites). On a cache hit this is an mmap replay; on a miss in
+        ``use``/``rebuild`` mode the avro read feeds an in-memory cache
+        build for the next run (no second decode)."""
+        if self._cached is not None:
+            try:
+                return self._cached.read_all(self.shard_configs, self.id_tags)
+            except _DEGRADABLE as e:
+                self._degrade("read", e)
+        reader = self._avro_reader()
+        data = reader.read(
+            self.paths, self.shard_configs, id_tags=self.id_tags
+        )
+        if self._should_build():
+            self._built = True
+            try:
+                with obs.span("cache.write", cat="io", rows=data.num_samples):
+                    write_game_data(
+                        self.cache_dir,
+                        data,
+                        shard_configs=self.shard_configs,
+                        id_tags=self.id_tags,
+                        source_files=self._source_files(),
+                        source_fingerprint=self._source_fingerprint,
+                        index_maps=reader.index_maps,
+                    )
+            except Exception as e:
+                report_build_failure("write", e)
+        return data
+
+    def _replay_with_fallback(self, chunk_rows: int) -> Iterator[GameData]:
+        """Cache replay honoring the degrade promise MID-STREAM too: a
+        replay failure after k chunks (a torn lazily-opened column, an
+        injected ``cache.read`` fault) resumes the avro path PAST the k
+        chunks already delivered — chunk boundaries are deterministic in
+        ``chunk_rows``, so skipping k avro chunks re-aligns exactly; the
+        consumer sees one uninterrupted, duplicate-free stream.
+        ``require`` mode still raises instead of degrading."""
+        yielded = 0
+        try:
+            for chunk in self._cached.iter_chunks(
+                self.shard_configs, self.id_tags, chunk_rows=chunk_rows
+            ):
+                yield chunk
+                yielded += 1
+        except _DEGRADABLE as e:
+            if self._caller_maps is None:
+                # a mapless warm consumer was being served the cache's
+                # stored index maps — the avro resume needs them too
+                # (chunked avro reads require maps up front). If the
+                # tear reaches the map columns themselves there is no
+                # map anywhere to resume with: propagate the original.
+                try:
+                    self._caller_maps = self._cached.index_maps_for(
+                        list(self.shard_configs)
+                    )
+                except _DEGRADABLE:
+                    raise e from None
+            self._degrade("replay", e)
+            # no build-through on the resumed stream: the first k chunks
+            # were never appended, so a partial build would be torn
+            for i, chunk in enumerate(
+                self._avro_reader().iter_chunks(
+                    self.paths,
+                    self.shard_configs,
+                    id_tags=self.id_tags,
+                    chunk_rows=chunk_rows,
+                )
+            ):
+                if i < yielded:
+                    continue
+                yield chunk
+
+    def iter_chunks(self, chunk_rows: int = 8192) -> Iterator[GameData]:
+        """Streamed GameData chunks (the scoring producer / out-of-core
+        ingest call sites). Cache hits slice the mmap at any chunk size;
+        misses stream avro and BUILD THROUGH — the cold run's single
+        decode also materializes the cache."""
+        if self._cached is not None:
+            return self._replay_with_fallback(chunk_rows)
+        reader = self._avro_reader()
+        chunks = reader.iter_chunks(
+            self.paths,
+            self.shard_configs,
+            id_tags=self.id_tags,
+            chunk_rows=chunk_rows,
+        )
+        if not self._should_build():
+            return chunks
+        self._built = True
+        try:
+            writer = FeatureCacheWriter(
+                self.cache_dir,
+                shard_configs=self.shard_configs,
+                id_tags=self.id_tags,
+                source_files=self._source_files(),
+                source_fingerprint=self._source_fingerprint,
+            )
+        except Exception as e:
+            report_build_failure("writer-construction", e)
+            return chunks
+        return build_through(
+            chunks, writer, index_maps_fn=lambda: reader.index_maps
+        )
+
+
+def resolve_reader(
+    paths,
+    shard_configs: Mapping,
+    *,
+    index_maps: Mapping | None = None,
+    id_tags: Sequence[str] = (),
+    mode: str | None = None,
+    cache_dir: str | None = None,
+) -> ResolvedReader:
+    """The ingest front door: resolve (paths, schema) to a cache replay
+    or the avro path per the mode (see the module docstring)."""
+    if isinstance(paths, (str, bytes)):
+        paths = [paths]
+    mode = cache_mode(mode)
+    if mode == "off":
+        return ResolvedReader(
+            mode=mode,
+            state="off",
+            paths=paths,
+            shard_configs=shard_configs,
+            id_tags=id_tags,
+            cache_dir=None,
+            cached=None,
+            index_maps=index_maps,
+        )
+    cdir = cache_dir or default_cache_dir(paths, shard_configs, id_tags)
+    verify = verify_on_open()  # knob validated up front, hit or miss
+    cached = None
+    state = "miss"
+    src_files: list | None = None
+    src_fp: list | None = None
+    if mode != "rebuild" and os.path.exists(os.path.join(cdir, MANIFEST)):
+        try:
+            candidate = CachedDataReader(cdir, verify_checksums=verify)
+            src_files = list_source_files(paths)
+            # hash the source set ONCE: the same fingerprint serves the
+            # staleness verdict here and, on a stale/corrupt rebuild,
+            # the new manifest (no second full sequential read)
+            from photon_tpu.cache.format import source_file_fingerprint
+
+            src_fp = source_file_fingerprint(src_files)
+            candidate.raise_if_stale(
+                src_files, shard_configs, id_tags, index_maps,
+                source_fingerprint=src_fp,
+            )
+            cached, state = candidate, "hit"
+        except CacheStaleError as e:
+            state = "stale"
+            obs.counter("cache.stale")
+            _fallback("stale", str(e))
+        except _DEGRADABLE as e:
+            state = "corrupt"
+            _fallback("open", f"{type(e).__name__}: {e}")
+    if cached is not None:
+        obs.counter("cache.hit")
+        obs.instant("cache.hit", cat="lifecycle", dir=cdir)
+    else:
+        if mode == "require":
+            raise FeatureCacheRequiredError(
+                f"PHOTON_FEATURE_CACHE=require but no fresh feature cache "
+                f"at {cdir} (state: {state}). Build and verify one with: "
+                f"python scripts/cache_tool.py build ... && "
+                f"python scripts/cache_tool.py verify {cdir}"
+            )
+        if state == "miss":
+            obs.counter("cache.miss")
+    return ResolvedReader(
+        mode=mode,
+        state=state,
+        paths=paths,
+        shard_configs=shard_configs,
+        id_tags=id_tags,
+        cache_dir=cdir,
+        cached=cached,
+        index_maps=index_maps,
+        source_files=src_files,
+        source_fingerprint=src_fp,
+    )

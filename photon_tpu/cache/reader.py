@@ -1,0 +1,291 @@
+"""Memory-mapped reader for the packed columnar feature cache.
+
+``CachedDataReader`` exposes the same data the avro path produces —
+``read_all`` (one GameData) and ``iter_chunks`` (fixed-row GameData
+chunks) — but every numeric column is an ``np.frombuffer`` view over an
+``mmap`` of the column file: a warm replay does ZERO avro decode and
+ZERO host assembly beyond slicing. Chunking is free at ANY ``chunk_rows``
+(the columns are flat), so the streaming scorer's producer thread
+becomes an mmap slice + H2D copy.
+
+Lifetime contract: the views alias the reader's mmaps. The reader is
+kept alive by the front door for the duration of the run (and numpy's
+buffer protocol pins each mmap while views exist — ``mmap.close()``
+raises ``BufferError`` rather than pulling pages out from under a live
+view), but a consumer that stores chunk columns BEYOND the reader's
+lifetime must ``.copy()`` them — exactly the bug class photon-lint
+PHL010 flags; the deliberate view sites in this module are the
+baselined, sanctioned ones.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+from typing import Iterator, Mapping, Sequence
+
+import numpy as np
+
+from photon_tpu import obs
+from photon_tpu.cache.format import (
+    CacheCorruptError,
+    CacheStaleError,
+    MANIFEST,
+    UID_COLUMNS,
+    check_columns,
+    column_dtype,
+    decode_strings,
+    imap_columns,
+    index_map_hash,
+    index_map_keys,
+    load_manifest,
+    shard_columns,
+    shard_config_fingerprint,
+    source_file_fingerprint,
+    tag_columns,
+)
+from photon_tpu.data.index_map import DefaultIndexMap
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.util import faults
+
+
+class CachedDataReader:
+    """mmap-backed replay of one cached dataset directory."""
+
+    def __init__(self, directory: str, *, verify_checksums: bool = False):
+        self.directory = str(directory)
+        # chaos hook: the open path — an injected fault here is the
+        # "cache unreadable at open" leg the front door must degrade on
+        faults.fault_point("cache.open")
+        with obs.span("cache.open", cat="io", dir=self.directory) as sp:
+            if not os.path.exists(os.path.join(self.directory, MANIFEST)):
+                raise FileNotFoundError(
+                    f"no cache manifest under {self.directory}"
+                )
+            self.manifest = load_manifest(self.directory)
+            problems = check_columns(
+                self.directory,
+                self.manifest,
+                verify_checksums=verify_checksums,
+            )
+            if problems:
+                raise CacheCorruptError(
+                    f"cache {self.directory} failed integrity checks: "
+                    + "; ".join(problems)
+                )
+            sp.set(
+                rows=int(self.manifest["num_samples"]),
+                verified=bool(verify_checksums),
+            )
+        self.num_samples = int(self.manifest["num_samples"])
+        self._mmaps: dict[str, mmap.mmap] = {}
+        self._arrays: dict[str, np.ndarray] = {}
+        self._vocabs: dict[str, np.ndarray] = {}
+        self._index_maps: dict[str, DefaultIndexMap] = {}
+
+    # -- columns ----------------------------------------------------------
+
+    def _col(self, name: str) -> np.ndarray:
+        """The ONE column-open path (numeric and blob columns alike —
+        blobs are u8 columns whose memoryview feeds the string
+        decoders), so fd/mmap handling lives in a single place."""
+        arr = self._arrays.get(name)
+        if arr is None:
+            dt = column_dtype(name)
+            meta = self.manifest["columns"].get(name)
+            if meta is None:
+                raise CacheCorruptError(
+                    f"cache {self.directory} has no column {name!r}"
+                )
+            if meta["bytes"] == 0:
+                arr = self._arrays[name] = np.empty(0, dtype=dt)
+                return arr
+            with open(os.path.join(self.directory, name), "rb") as f:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            self._mmaps[name] = mm
+            # sanctioned mmap view (PHL010 baseline entry): mmap and view
+            # live together on this reader for its whole lifetime, and
+            # numpy's buffer export pins the mmap besides — see the
+            # module docstring's lifetime contract
+            self._arrays[name] = np.frombuffer(mm, dtype=dt)
+            arr = self._arrays[name]
+        return arr
+
+    def _vocab(self, tag: str) -> np.ndarray:
+        v = self._vocabs.get(tag)
+        if v is None:
+            names = tag_columns(tag)
+            offs = self._col(names["vocab_offs"])
+            strings = decode_strings(offs, self._col(names["vocab_blob"]))
+            v = np.asarray(strings, dtype=str) if strings else np.empty(
+                0, dtype="<U1"
+            )
+            self._vocabs[tag] = v
+        return v
+
+    # -- validation -------------------------------------------------------
+
+    def validate_sources(
+        self,
+        source_files: Sequence[str],
+        shard_configs: Mapping,
+        id_tags: Sequence[str] = (),
+        index_maps: Mapping | None = None,
+        source_fingerprint: list | None = None,
+    ) -> list[str]:
+        """Staleness check against what the caller is ABOUT to read:
+        source file set (content sha256s), shard configs, id tags, and —
+        when the caller brings its own maps — index-map hashes. Returns
+        mismatch descriptions (empty = fresh). The cache may carry a
+        SUPERSET of the requested shards/tags. ``source_fingerprint``
+        short-circuits the hashing when the caller already computed it
+        (the front door reuses one fingerprint for verdict AND rebuild)."""
+        fp = self.manifest.get("fingerprint", {})
+        problems: list[str] = []
+        expected = (
+            source_fingerprint
+            if source_fingerprint is not None
+            else source_file_fingerprint(source_files)
+        )
+        if fp.get("sources") != expected:
+            problems.append(
+                f"source file set changed ({len(expected)} files vs "
+                f"{len(fp.get('sources', []))} cached)"
+            )
+        cached_cfg = fp.get("shard_configs", {})
+        for name, want in shard_config_fingerprint(shard_configs).items():
+            if cached_cfg.get(name) != want:
+                problems.append(f"feature shard config {name!r} changed")
+        cached_tags = set(fp.get("id_tags", []))
+        missing_tags = set(id_tags) - cached_tags
+        if missing_tags:
+            problems.append(f"id tags {sorted(missing_tags)} not cached")
+        if index_maps:
+            cached_hashes = fp.get("index_maps", {})
+            for shard in shard_configs:
+                imap = index_maps.get(shard)
+                cached_h = cached_hashes.get(shard)
+                if imap is None or cached_h is None:
+                    continue
+                keys = index_map_keys(imap)
+                if keys is not None and index_map_hash(keys) != cached_h:
+                    problems.append(f"index map for shard {shard!r} changed")
+        return problems
+
+    def raise_if_stale(self, *args, **kwargs) -> None:
+        problems = self.validate_sources(*args, **kwargs)
+        if problems:
+            raise CacheStaleError(
+                f"cache {self.directory} is stale: " + "; ".join(problems)
+            )
+
+    # -- index maps -------------------------------------------------------
+
+    def index_maps_for(self, shards: Sequence[str]) -> dict:
+        """Reconstruct the stored per-shard index maps (identical indices
+        to the maps the cache was built with)."""
+        out = {}
+        for shard in shards:
+            m = self._index_maps.get(shard)
+            if m is None:
+                names = imap_columns(shard)
+                if names["offs"] not in self.manifest["columns"]:
+                    raise CacheStaleError(
+                        f"cache {self.directory} stores no index map for "
+                        f"shard {shard!r}; supply one (off-heap store or "
+                        "model vocabulary)"
+                    )
+                keys = decode_strings(
+                    self._col(names["offs"]), self._col(names["blob"])
+                )
+                m = DefaultIndexMap({k: i for i, k in enumerate(keys)})
+                self._index_maps[shard] = m
+            out[shard] = m
+        return out
+
+    # -- data -------------------------------------------------------------
+
+    def _uids_slice(self, lo: int, hi: int) -> list | None:
+        if not self.manifest.get("has_uids"):
+            return None
+        offs = self._col(UID_COLUMNS["offs"])
+        mask = self._col(UID_COLUMNS["mask"])
+        mv = memoryview(self._col(UID_COLUMNS["blob"]))
+        out: list = []
+        for i in range(lo, hi):
+            if mask[i]:
+                out.append(str(mv[offs[i] : offs[i + 1]], "utf-8"))
+            else:
+                out.append(None)
+        return out
+
+    def _chunk(
+        self,
+        lo: int,
+        hi: int,
+        shard_configs: Mapping,
+        id_tags: Sequence[str],
+    ) -> GameData:
+        shards = {}
+        served = 0
+        for shard in shard_configs:
+            names = shard_columns(shard)
+            meta = self.manifest["shards"].get(shard)
+            if meta is None:
+                raise CacheStaleError(
+                    f"cache {self.directory} has no shard {shard!r}"
+                )
+            indptr = self._col(names["indptr"])
+            nz_lo, nz_hi = int(indptr[lo]), int(indptr[hi])
+            shards[shard] = CSRMatrix(
+                indptr=np.asarray(indptr[lo : hi + 1]) - nz_lo,
+                indices=self._col(names["indices"])[nz_lo:nz_hi],
+                values=self._col(names["values"])[nz_lo:nz_hi],
+                num_cols=int(meta["num_cols"]),
+            )
+            served += (hi + 1 - lo) * 8 + (nz_hi - nz_lo) * 12
+        tags = {}
+        for tag in id_tags:
+            codes = self._col(tag_columns(tag)["codes"])[lo:hi]
+            tags[tag] = self._vocab(tag)[codes]
+            served += (hi - lo) * 4
+        served += (hi - lo) * 24  # labels/offsets/weights
+        obs.counter("cache.bytes", served)
+        return GameData(
+            labels=self._col("labels.f64")[lo:hi],
+            offsets=self._col("offsets.f64")[lo:hi],
+            weights=self._col("weights.f64")[lo:hi],
+            feature_shards=shards,
+            id_tags=tags,
+            uids=self._uids_slice(lo, hi),
+            provenance={"source": "cache", "dir": self.directory},
+        )
+
+    def read_all(
+        self, shard_configs: Mapping, id_tags: Sequence[str] = ()
+    ) -> GameData:
+        """The monolithic replay: one GameData over the full columns
+        (numeric columns are zero-copy mmap views)."""
+        faults.fault_point("cache.read")
+        with obs.span("cache.read", cat="io", rows=self.num_samples):
+            return self._chunk(0, self.num_samples, shard_configs, id_tags)
+
+    def iter_chunks(
+        self,
+        shard_configs: Mapping,
+        id_tags: Sequence[str] = (),
+        chunk_rows: int = 8192,
+    ) -> Iterator[GameData]:
+        """Fixed-row chunks (last one smaller), the ``iter_chunks``
+        contract of ``AvroDataReader`` — same chunk shapes for the same
+        ``chunk_rows`` regardless of how the SOURCE was partitioned."""
+        if chunk_rows < 1:
+            raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+        n = self.num_samples
+        for lo in range(0, n, chunk_rows):
+            hi = min(lo + chunk_rows, n)
+            # chaos hook PER CHUNK: occurrence k is the k-th chunk, so
+            # the matrix can tear the replay mid-stream, not just at
+            # entry (the front door resumes the avro path chunk-aligned)
+            faults.fault_point("cache.read")
+            with obs.span("cache.read", cat="io", rows=hi - lo):
+                yield self._chunk(lo, hi, shard_configs, id_tags)

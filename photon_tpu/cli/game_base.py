@@ -50,6 +50,16 @@ def add_common_arguments(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--evaluators", default=None, help="comma-separated evaluator types")
     p.add_argument(
+        "--feature-cache",
+        default=None,
+        choices=["off", "use", "require", "rebuild"],
+        help="packed columnar feature cache (photon_tpu/cache): 'use' "
+        "replays a fresh cache (and builds one on a miss), 'require' "
+        "refuses to decode avro (scripts/cache_tool.py builds/verifies "
+        "caches), 'rebuild' forces a fresh build; env "
+        "PHOTON_FEATURE_CACHE overrides (default off)",
+    )
+    p.add_argument(
         "--root-output-directory", required=True, help="driver output root"
     )
     p.add_argument(
@@ -106,10 +116,22 @@ def read_game_data(
     shard_configs: dict[str, FeatureShardConfig],
     index_maps: dict[str, IndexMap] | None,
     id_tags=(),
+    cache: str | None = None,
 ) -> tuple[GameData, dict[str, IndexMap]]:
-    reader = AvroDataReader(index_maps=index_maps)
-    data = reader.read(paths, shard_configs, id_tags=tuple(id_tags))
-    return data, reader.index_maps
+    """One materialized GameData through the ingest front door
+    (photon_tpu/cache): ``cache`` is the ``--feature-cache`` mode (env
+    ``PHOTON_FEATURE_CACHE`` wins; default off = the plain avro read)."""
+    from photon_tpu.cache import resolve_reader
+
+    resolved = resolve_reader(
+        paths,
+        shard_configs,
+        index_maps=index_maps,
+        id_tags=tuple(id_tags),
+        mode=cache,
+    )
+    data = resolved.read()
+    return data, resolved.index_maps
 
 
 def evaluators_from_args(args):

@@ -164,12 +164,12 @@ def _score_streaming(
     avro writers, with the label/weight/id-tag columns (cheap, O(N))
     accumulated only when evaluators will consume them. Returns None
     when the model layout needs the monolithic fallback."""
+    from photon_tpu.cache import resolve_reader
     from photon_tpu.game.scoring import (
         UnsupportedModelLayout,
         score_batch_rows,
         score_output_partitions,
     )
-    from photon_tpu.io.data_reader import AvroDataReader
 
     # knob validation happens BEFORE the layout fallback: a bad
     # --score-batch-rows / env value must raise, not silently demote the
@@ -186,10 +186,20 @@ def _score_streaming(
         return None
 
     paths = game_base.resolve_input_paths(args)
-    reader = AvroDataReader(index_maps=index_maps)
-    chunks = reader.iter_chunks(
-        paths, shard_configs, id_tags=tuple(id_tags), chunk_rows=batch_rows
+    # the ingest front door: a fresh feature cache turns the producer
+    # thread into mmap slice + H2D copy (zero avro decode); a miss in
+    # 'use' mode streams avro and builds the cache through the same
+    # single decode (photon_tpu/cache)
+    resolved = resolve_reader(
+        paths,
+        shard_configs,
+        index_maps=index_maps,
+        id_tags=tuple(id_tags),
+        mode=args.feature_cache,
     )
+    if resolved.mode != "off":
+        log.info("feature cache: %s", resolved.describe())
+    chunks = resolved.iter_chunks(chunk_rows=batch_rows)
     writer = ShardedScoringWriter(
         os.path.join(out_root, SCORES_DIR),
         num_partitions=partitions,
@@ -242,6 +252,7 @@ def _score_streaming(
         "maxStagedChunks": result.stats.max_staged_chunks,
         "batchLatency": result.stats.latency_percentiles(),
         "outputFiles": writer.paths(),
+        "featureCache": resolved.describe(),
     }
     return result.scores, n, columns, detail
 
@@ -337,7 +348,8 @@ def run(argv=None) -> dict:
             with Timed("read scoring data"):
                 paths = game_base.resolve_input_paths(args)
                 data, _ = game_base.read_game_data(
-                    paths, shard_configs, index_maps, id_tags
+                    paths, shard_configs, index_maps, id_tags,
+                    cache=args.feature_cache,
                 )
             log.info("scoring %d samples (monolithic)", data.num_samples)
             transformer = GameTransformer(model=model, task=model.task)

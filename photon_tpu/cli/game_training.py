@@ -405,7 +405,8 @@ def run(argv=None) -> dict:
             paths = game_base.resolve_input_paths(args)
             index_maps = game_base.prepare_feature_maps(args, shard_configs)
             data, index_maps = game_base.read_game_data(
-                paths, shard_configs, index_maps, id_tags
+                paths, shard_configs, index_maps, id_tags,
+                cache=args.feature_cache,
             )
         log.info(
             "read %d samples, shards %s",
@@ -423,7 +424,8 @@ def run(argv=None) -> dict:
                 )
                 v_paths = game_base.resolve_input_paths(v_args)
                 validation_data, _ = game_base.read_game_data(
-                    v_paths, shard_configs, index_maps, validation_id_tags
+                    v_paths, shard_configs, index_maps, validation_id_tags,
+                    cache=args.feature_cache,
                 )
 
         with Timed("data validation"):

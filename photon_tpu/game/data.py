@@ -99,6 +99,10 @@ class GameData:
     feature_shards: Mapping[str, CSRMatrix]
     id_tags: Mapping[str, np.ndarray]  # tag → [N] array of entity keys
     uids: Sequence[str | None] | None = None  # per-sample ids (score output)
+    #: ingest provenance, set by the reader that produced this data (the
+    #: feature cache tags {"source": "cache", ...}); None for host-built
+    #: or avro-decoded data. Informational only — slices/concats drop it.
+    provenance: Mapping | None = None
 
     def __post_init__(self):
         n = self.num_samples

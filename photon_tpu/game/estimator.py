@@ -418,10 +418,15 @@ class GameEstimator:
                 raise
             wall_s = time.perf_counter() - t_fit
             cw = compile_watch.delta(fit_c0)
+            # ingest provenance: "cache" when the data came from the
+            # feature-cache replay (zero avro decode), "host" otherwise —
+            # the field that lets a profile reader tell a warm run apart
+            prov = getattr(data, "provenance", None) or {}
             #: per-fit telemetry summary (deltas over this call only)
             self.last_fit_stats = {
                 "wall_s": round(wall_s, 4),
                 "dispatches": dispatch_count.snapshot() - fit_d0,
+                "ingest": prov.get("source", "host"),
                 **cw,
             }
             fit_span.set(**self.last_fit_stats)

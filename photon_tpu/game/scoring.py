@@ -790,6 +790,12 @@ class GameScorer:
                     with staged.lock:
                         staged.value -= 1
                     chunk = item
+                    if stats.batches == 0 and not stats.batch_walls_s:
+                        # ingest provenance on the stream root: "cache"
+                        # chunks came from the mmap replay (zero decode)
+                        prov = getattr(chunk, "provenance", None)
+                        if prov:
+                            root.set(ingest=prov.get("source"))
                     with obs.span("score.ingest", rows=chunk.num_samples):
                         host_batch = self._host_batch(chunk)
                         key = self._shape_key(host_batch)

@@ -30,7 +30,9 @@ table): ``coordinate.placement``, ``sparse.placement``, ``io.decode``,
 ``io.native_decode``, ``io.shard_flush``, ``descent.sweep``,
 ``descent.coordinate`` (NaN injection), ``checkpoint.write``,
 ``checkpoint.replace``, ``scoring.producer``, ``scoring.chunk``,
-``scoring.batch``.
+``scoring.batch``, and the feature-cache paths ``cache.write`` (per
+appended chunk), ``cache.replace`` (the publish rename window),
+``cache.open`` (reader open/validate), ``cache.read`` (mmap replay).
 
 Fault plan
 ----------

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Build / inspect / verify a packed columnar feature cache.
+
+The operator's side of ``photon_tpu/cache``: the drivers consume caches
+through the ``--feature-cache`` knob, and when ``require`` mode rejects
+a missing/stale/torn cache they point here.
+
+    # build (streams the avro parts through the cache writer):
+    python scripts/cache_tool.py build \
+        --input-data-directories /data/day1 \
+        --feature-shard-configurations "global=global,feature.bags=features" \
+        --id-tags userId,itemId \
+        [--off-heap-index-map-dir STORE] [--cache-dir DIR] [--chunk-rows N]
+
+    # inspect (manifest summary + per-column sizes/checksums):
+    python scripts/cache_tool.py inspect CACHE_DIR
+
+    # verify (recompute every column sha256; exit 2 on a torn column):
+    python scripts/cache_tool.py verify CACHE_DIR
+
+    # prune (evict keyed caches older than N days under a cache root —
+    # a rolling date window mints a new key per day, so roots grow
+    # without this):
+    python scripts/cache_tool.py prune /data/day1/_photon_cache \
+        --older-than-days 14 [--dry-run]
+
+``build`` resolves the cache location exactly like the drivers do (same
+schema+paths key), so a cache built here is the cache a later
+``--feature-cache require`` run opens.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _build(args) -> int:
+    from photon_tpu.cache import default_cache_dir, list_source_files
+    from photon_tpu.cache.writer import FeatureCacheWriter
+    from photon_tpu.cli.parsing import parse_feature_shard_config
+    from photon_tpu.io.data_reader import AvroDataReader
+    from photon_tpu.util import faults
+
+    faults.install_from_env()  # the chaos drive's subprocess hook
+    shard_configs = {}
+    for s in args.feature_shard_configurations:
+        name, cfg = parse_feature_shard_config(s)
+        shard_configs[name] = cfg
+    id_tags = tuple(
+        t.strip() for t in (args.id_tags or "").split(",") if t.strip()
+    )
+    paths = [
+        p.strip() for p in args.input_data_directories.split(",") if p.strip()
+    ]
+    index_maps = None
+    if args.off_heap_index_map_dir:
+        from photon_tpu.data.native_index import load_partitioned_store
+
+        index_maps = {
+            shard: load_partitioned_store(args.off_heap_index_map_dir, shard)
+            for shard in shard_configs
+        }
+    reader = AvroDataReader(index_maps=index_maps)
+    if index_maps is None:
+        # chunked builds need the maps up front: one generation pass
+        # (the cache then stores them, so WARM runs never pay this)
+        print("no off-heap maps: generating index maps (one extra pass)")
+        reader.read(paths, shard_configs, id_tags=id_tags)
+    cache_dir = args.cache_dir or default_cache_dir(
+        paths, shard_configs, id_tags
+    )
+    files = list_source_files(paths)
+    writer = FeatureCacheWriter(
+        cache_dir,
+        shard_configs=shard_configs,
+        id_tags=id_tags,
+        source_files=files,
+    )
+    rows = 0
+    try:
+        for chunk in reader.iter_chunks(
+            paths, shard_configs, id_tags=id_tags, chunk_rows=args.chunk_rows
+        ):
+            writer.append(chunk)
+            rows += chunk.num_samples
+        final = writer.finalize(index_maps=reader.index_maps)
+    except BaseException:
+        writer.abort()
+        raise
+    print(f"built feature cache: {final} ({rows} rows)")
+    return 0
+
+
+def _load(cache_dir: str) -> dict:
+    from photon_tpu.cache.format import load_manifest
+
+    return load_manifest(cache_dir)
+
+
+def _inspect(args) -> int:
+    manifest = _load(args.cache_dir)
+    fp = manifest.get("fingerprint", {})
+    print(f"cache: {args.cache_dir}")
+    print(f"  format_version : {manifest['format_version']}")
+    print(f"  num_samples    : {manifest['num_samples']}")
+    print(f"  id_tags        : {manifest.get('id_tags')}")
+    print(f"  has_uids       : {manifest.get('has_uids')}")
+    print(f"  chunks         : {len(manifest.get('chunk_boundaries', [1])) - 1}")
+    print(f"  fingerprint    : {manifest.get('fingerprint_sha256')}")
+    print(f"  source files   : {len(fp.get('sources', []))}")
+    for s, meta in manifest.get("shards", {}).items():
+        print(
+            f"  shard {s!r}: num_cols={meta['num_cols']} nnz={meta['nnz']} "
+            f"max_row_nnz={meta['max_row_nnz']} "
+            f"ell_levels={meta['ell_levels']}"
+        )
+    total = 0
+    for name, meta in sorted(manifest.get("columns", {}).items()):
+        print(f"  column {name}: {meta['bytes']} bytes sha256={meta['sha256'][:12]}…")
+        total += meta["bytes"]
+    print(f"  total column bytes: {total}")
+    if args.json:
+        print(json.dumps(manifest, indent=2, sort_keys=True))
+    return 0
+
+
+def _verify(args) -> int:
+    from photon_tpu.cache.format import check_columns
+
+    manifest = _load(args.cache_dir)
+    problems = check_columns(
+        args.cache_dir, manifest, verify_checksums=True
+    )
+    if problems:
+        print(f"TORN CACHE: {len(problems)} problem(s) in {args.cache_dir}")
+        for p in problems:
+            print(f"  - {p}")
+        return 2
+    n = len(manifest.get("columns", {}))
+    print(
+        f"cache OK: {n} columns verified against their manifest sha256s "
+        f"({manifest['num_samples']} rows)"
+    )
+    return 0
+
+
+def _prune(args) -> int:
+    """Evict stale keyed caches under a cache root. Keys accumulate by
+    design (the key hashes the path set, so a rolling date window mints
+    a new one per day) — prune is the bounded-disk half of that
+    contract. A directory is pruned when its manifest's creation stamp
+    is older than ``--older-than-days`` (unreadable/torn directories
+    count as prunable droppings). ``--dry-run`` only reports."""
+    import shutil
+    import time
+
+    from photon_tpu.cache.format import MANIFEST
+
+    root = args.cache_root
+    if not os.path.isdir(root):
+        print(f"no cache root at {root}")
+        return 0
+    cutoff = time.time() - args.older_than_days * 86400.0  # phl-ok: PHL006 compares manifest epoch stamps, not durations between monotonic events
+    pruned = kept = 0
+    for entry in sorted(os.listdir(root)):
+        path = os.path.join(root, entry)
+        if not os.path.isdir(path):
+            continue
+        manifest_path = os.path.join(path, MANIFEST)
+        created = None
+        try:
+            with open(manifest_path, encoding="utf-8") as f:
+                created = json.load(f).get("created_unix")
+        except (OSError, ValueError):
+            created = None  # torn/partial: a dropping, prunable
+        stale = created is None or created < cutoff
+        if stale:
+            pruned += 1
+            age = "unreadable" if created is None else (
+                f"{(time.time() - created) / 86400.0:.1f}d old"  # phl-ok: PHL006 human-readable age from the manifest's epoch anchor
+            )
+            print(f"prune {path} ({age})")
+            if not args.dry_run:
+                shutil.rmtree(path, ignore_errors=True)
+        else:
+            kept += 1
+    print(
+        f"{'would prune' if args.dry_run else 'pruned'} {pruned} cache(s), "
+        f"kept {kept} (older than {args.older_than_days} days)"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cache_tool", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("build", help="stream avro parts into a cache")
+    b.add_argument("--input-data-directories", required=True)
+    b.add_argument(
+        "--feature-shard-configurations", action="append", required=True
+    )
+    b.add_argument("--id-tags", default="")
+    b.add_argument("--off-heap-index-map-dir", default=None)
+    b.add_argument("--cache-dir", default=None)
+    b.add_argument("--chunk-rows", type=int, default=8192)
+    b.set_defaults(fn=_build)
+
+    i = sub.add_parser("inspect", help="print the manifest summary")
+    i.add_argument("cache_dir")
+    i.add_argument("--json", action="store_true", help="dump the raw manifest")
+    i.set_defaults(fn=_inspect)
+
+    v = sub.add_parser("verify", help="recompute column checksums")
+    v.add_argument("cache_dir")
+    v.set_defaults(fn=_verify)
+
+    pr = sub.add_parser(
+        "prune",
+        help="evict keyed caches older than N days under a cache root "
+        "(e.g. <data dir>/_photon_cache) — rolling path sets mint a new "
+        "key per window, so roots grow without this",
+    )
+    pr.add_argument("cache_root")
+    pr.add_argument("--older-than-days", type=float, default=14.0)
+    pr.add_argument("--dry-run", action="store_true")
+    pr.set_defaults(fn=_prune)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

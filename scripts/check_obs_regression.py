@@ -120,6 +120,60 @@ def build_canonical_fit():
     return est, data
 
 
+def _canonical_cache_leg() -> None:
+    """Deterministic cold→warm feature-cache exercise (see the call
+    site): fixed records, fixed shapes, python decode pinned."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu.cache import resolve_reader
+    from photon_tpu.io.avro import write_avro_file
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+    rng = np.random.default_rng(11)
+    data_dir = tempfile.mkdtemp(prefix="obs-gate-cache-")
+    os.environ["PHOTON_NO_NATIVE_AVRO"] = "1"
+    try:
+        for p in range(2):
+            write_avro_file(
+                os.path.join(data_dir, f"part-{p:05d}.avro"),
+                TRAINING_EXAMPLE_AVRO,
+                [
+                    {
+                        "uid": f"c{p}-{i}",
+                        "label": float(rng.normal()),
+                        "features": [
+                            {
+                                "name": f"f{j}",
+                                "term": "",
+                                "value": float(rng.normal()),
+                            }
+                            for j in range(5)
+                        ],
+                        "metadataMap": {"userId": f"u{i % 7}"},
+                        "weight": 1.0,
+                        "offset": 0.0,
+                    }
+                    for i in range(30)
+                ],
+            )
+        shard_configs = {
+            "g": FeatureShardConfig(
+                feature_bags=("features",), has_intercept=False
+            )
+        }
+        for mode in ("use", "use"):  # cold (miss+build), then warm (hit)
+            resolve_reader(
+                data_dir, shard_configs, id_tags=("userId",), mode=mode
+            ).read()
+    finally:
+        os.environ.pop("PHOTON_NO_NATIVE_AVRO", None)
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
 def collect_snapshot() -> dict:
     """Run the canonical fit (and a canonical streaming score of the
     fitted model — the ``score.*`` taxonomy) under a clean telemetry
@@ -146,6 +200,9 @@ def collect_snapshot() -> dict:
         k: os.environ.pop(k)
         for k in list(os.environ)
         if k.startswith("PHOTON_SCORE_")
+        # feature-cache knobs: an exported mode/dir/verify flag would
+        # change the canonical cache leg's hit/miss/verify counters
+        or k.startswith("PHOTON_FEATURE_CACHE")
         or k
         in (
             "PHOTON_OBS_MEM",
@@ -156,6 +213,9 @@ def collect_snapshot() -> dict:
             "PHOTON_OBS_RING_MB",
             "PHOTON_OBS_FLUSH_S",
             "PHOTON_OBS_HTTP_PORT",
+            # the cache leg pins the python decoder explicitly; an
+            # ambient export must not double the io.decode census
+            "PHOTON_NO_NATIVE_AVRO",
         )
     }
     flight_dir = None
@@ -201,6 +261,14 @@ def collect_snapshot() -> dict:
         GameScorer(
             results[0].model, batch_rows=SCORE_BATCH_ROWS
         ).score_data(data)
+        # canonical feature-cache leg: a tiny FIXED avro dataset read
+        # COLD (miss → decode → opportunistic build) then WARM (mmap
+        # hit), pinning the cache.* counter/span taxonomy — cache.miss/
+        # build/build_bytes/write_rows on the cold side, cache.hit/bytes
+        # + the cache.open/cache.read spans on the warm side. The decode
+        # is pinned to the python codec so the io.decode census cannot
+        # depend on whether the native .so loaded on this machine.
+        _canonical_cache_leg()
         SeriesFlusher(
             os.path.join(flight_dir, "series.jsonl"), 60.0
         ).flush_once()

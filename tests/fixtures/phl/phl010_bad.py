@@ -1,0 +1,33 @@
+"""PHL010 positive: mmap-backed views escaping their owning function.
+
+The feature-cache bug class: the mmap closes (or its owner dies) while
+a zero-copy ``np.frombuffer`` view is still live — first touch after
+that is a SIGBUS over unmapped pages.
+"""
+import mmap
+
+import numpy as np
+
+
+def load_column(path):
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        return np.frombuffer(mm, dtype=np.float64)  # BUG: returned view
+
+
+def load_direct(fd):
+    # BUG: view over an anonymous mmap expression, returned
+    return np.frombuffer(mmap.mmap(fd, 0), dtype=np.int32)
+
+
+class ColumnStore:
+    def open_column(self, f):
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        # BUG: stored view outlives this frame; nothing keeps mm open
+        self.column = np.frombuffer(mm, dtype=np.float32)
+
+
+def hand_off(f, sink):
+    mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    sink(np.frombuffer(mm, dtype=np.int64))  # BUG: view passed to a call
+    mm.close()  # the view the sink kept now aliases unmapped pages

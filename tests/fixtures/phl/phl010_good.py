@@ -1,0 +1,24 @@
+"""PHL010 negative: copies before escape, or owner-scoped views."""
+import mmap
+
+import numpy as np
+
+
+def load_column(path):
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        out = np.frombuffer(mm, dtype=np.float64).copy()  # snapshot
+        mm.close()
+        return out
+
+
+def column_sum(f):
+    mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    view = np.frombuffer(mm, dtype=np.float64)  # stays local
+    total = float(view.sum())
+    return total
+
+
+def frombuffer_over_bytes(blob):
+    # not an mmap: bytes objects are immortal while referenced
+    return np.frombuffer(blob, dtype=np.int32)

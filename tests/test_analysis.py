@@ -29,7 +29,7 @@ FIXTURES = REPO / "tests" / "fixtures" / "phl"
 
 ALL_RULES = (
     "PHL001", "PHL002", "PHL003", "PHL004", "PHL005", "PHL006",
-    "PHL007", "PHL008", "PHL009",
+    "PHL007", "PHL008", "PHL009", "PHL010",
 )
 
 

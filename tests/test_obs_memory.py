@@ -454,6 +454,7 @@ def test_quality_band_requires_memory_columns():
         "scale": "smoke",
         "grouped_auc": {"value": 0.9},
         "mem": {"peak_bytes": 123456, "exec_temp_bytes": 789},
+        "cache": {"parity_max_abs": 0.0, "warm_decode_spans": 0},
     }
     assert check_quality_bands("glmix_game_estimator", healthy) == []
     for broken in (
@@ -486,6 +487,7 @@ def _cfg(eps, backend="cpu", scale="smoke", **extra):
         "scale": scale,
         "grouped_auc": {"value": 0.9},
         "mem": {"peak_bytes": 1000, "exec_temp_bytes": 10},
+        "cache": {"parity_max_abs": 0.0, "warm_decode_spans": 0},
         **extra,
     }
 

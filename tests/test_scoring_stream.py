@@ -493,20 +493,37 @@ def test_score_cold_does_not_rebuild_vocab_indices():
 def test_scoring_quality_bands():
     import bench
 
-    good = {"parity": {"max_rel_diff": 1e-7}, "steady_compiles": 0}
+    healthy_cache = {"parity_max_abs": 0.0, "warm_decode_spans": 0}
+    good = {
+        "parity": {"max_rel_diff": 1e-7},
+        "steady_compiles": 0,
+        "cache": healthy_cache,
+    }
     assert bench.check_quality_bands("game_scoring_stream", good) == []
-    divergent = {"parity": {"max_rel_diff": 0.5}, "steady_compiles": 0}
+    divergent = dict(good, parity={"max_rel_diff": 0.5})
     assert any(
         "parity" in v
         for v in bench.check_quality_bands("game_scoring_stream", divergent)
     )
-    retracing = {"parity": {"max_rel_diff": 1e-7}, "steady_compiles": 3}
+    retracing = dict(good, steady_compiles=3)
     assert any(
         "steady-state" in v
         for v in bench.check_quality_bands("game_scoring_stream", retracing)
     )
+    # a cached replay that differs from the avro stream must fail…
+    drifted = dict(good, cache={"parity_max_abs": 1e-3, "warm_decode_spans": 0})
+    assert any(
+        "feature-cache wire parity" in v
+        for v in bench.check_quality_bands("game_scoring_stream", drifted)
+    )
+    # …and so must a warm run that still decoded avro
+    leaky = dict(good, cache={"parity_max_abs": 0.0, "warm_decode_spans": 2})
+    assert any(
+        "io.decode" in v
+        for v in bench.check_quality_bands("game_scoring_stream", leaky)
+    )
     missing = {}
-    assert len(bench.check_quality_bands("game_scoring_stream", missing)) == 2
+    assert len(bench.check_quality_bands("game_scoring_stream", missing)) == 4
 
 
 def test_consumer_failure_reaps_producer_and_scorer_is_reusable():
