@@ -119,6 +119,78 @@ def build_canonical_fixture(mesh: Any = None) -> dict[str, Any]:
     return coordinates
 
 
+def build_estimator_fixture(mesh: Any = None) -> dict[str, Any]:
+    """The MESHED ESTIMATOR's own executables as an audit corpus: a small
+    FE + RE ``GameEstimator.fit(mesh=...)`` runs end-to-end (precompile
+    on, two sweeps), and the coordinates it built — with the AOT
+    executables the fit actually dispatched — are returned for the same
+    contract checks the synthetic fixture gets. This is the difference
+    between auditing a hand-assembled lookalike and auditing the real
+    production build path (``pad_game_data`` → ShapePool → entity-
+    sharded dataset → ``precompile_coordinates`` inside ``fit``): a
+    regression anywhere in that chain now fails the gate even when the
+    synthetic fixture stays clean."""
+    import numpy as np
+
+    from photon_tpu.game.config import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.game.data import CSRMatrix, GameData
+    from photon_tpu.game.estimator import GameEstimator
+    from photon_tpu.optimize.common import OptimizerConfig
+    from photon_tpu.optimize.problem import (
+        GLMProblemConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(7)
+    n, fe_dim, users, d_re = 256, 16, 24, 6
+    ids = rng.integers(0, users, size=n)
+    data = GameData.build(
+        labels=(rng.uniform(size=n) < 0.5).astype(np.float64),
+        feature_shards={
+            "global": CSRMatrix.from_dense(
+                rng.normal(size=(n, fe_dim)).astype(np.float32)
+            ),
+            "per_user": CSRMatrix.from_dense(
+                rng.normal(size=(n, d_re)).astype(np.float32)
+            ),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=3),
+        regularization=RegularizationContext(RegularizationType.L2),
+    )
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "global": FixedEffectCoordinateConfig(
+                feature_shard="global", optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+            "per_user": RandomEffectCoordinateConfig(
+                random_effect_type="userId", feature_shard="per_user",
+                optimization=opt, regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["global", "per_user"],
+        descent_iterations=2,
+        precompile=True,
+        mesh=mesh,
+        keep_coordinates=True,  # the audit reads the fit's executables
+    )
+    est.fit(data)
+    coordinates: dict[str, Any] = dict(est.last_coordinates or {})
+    if not coordinates:
+        raise RuntimeError("estimator fit built no coordinates")
+    return coordinates
+
+
 def build_scorer_fixture(coordinates: dict[str, Any]) -> Any:
     """A GameScorer over the canonical fixture's exported model, its
     fused per-batch-shape program precompiled — the streaming engine's
@@ -213,6 +285,22 @@ def run_program_checks(jsonl_rows: list[dict[str, Any]]) -> int:
     reports = [
         audit_coordinates(coordinates, shape_budget=re_shape_budget(None))
     ]
+    # the meshed ESTIMATOR's own executables (not just the synthetic
+    # fixture): a real end-to-end GameEstimator.fit(mesh=...) with
+    # precompile, audited against the same per-coordinate contracts —
+    # CommAllowance violations in the production build path fail the job
+    estimator_error: Exception | None = None
+    estimator_programs = 0
+    try:
+        est_coordinates = build_estimator_fixture(mesh=mesh)
+        reports.append(
+            audit_coordinates(
+                est_coordinates, shape_budget=re_shape_budget(None)
+            )
+        )
+        estimator_programs = reports[-1].programs_checked
+    except Exception as e:
+        estimator_error = e
     # a broken scorer build is itself a gate failure, but it must not
     # MASK the coordinate audit: the census/finding rows collected so
     # far still print and land in the --jsonl artifact either way
@@ -229,8 +317,9 @@ def run_program_checks(jsonl_rows: list[dict[str, Any]]) -> int:
     skipped = [s for r in reports for s in r.skipped]
     print(
         f"[photon-lint] program checks: {programs} precompiled "
-        f"executables audited ({reports[0].programs_checked} coordinate "
-        f"+ {scorer_programs} scorer), "
+        f"executables audited ({reports[0].programs_checked} fixture "
+        f"coordinate + {estimator_programs} estimator-fit + "
+        f"{scorer_programs} scorer), "
         f"{len(reports[0].census)} distinct solve shapes, mesh="
         f"{'none' if mesh is None else 'x'.join(map(str, mesh.devices.shape))}"
     )
@@ -253,11 +342,23 @@ def run_program_checks(jsonl_rows: list[dict[str, Any]]) -> int:
             f"{scorer_error}"
         )
         return 1
+    if estimator_error is not None:
+        print(
+            f"[photon-lint] ERROR: meshed estimator fixture failed to "
+            f"fit: {estimator_error}"
+        )
+        return 1
     if programs == 0:
         print("[photon-lint] ERROR: precompile produced no executables")
         return 1
     if scorer_programs == 0:
         print("[photon-lint] ERROR: scorer precompile produced no executables")
+        return 1
+    if estimator_programs == 0:
+        print(
+            "[photon-lint] ERROR: the estimator fit produced no "
+            "precompiled executables to audit"
+        )
         return 1
     if len(skipped) >= programs:
         # every executable's module text was unreadable: zero contract

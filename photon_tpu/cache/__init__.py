@@ -140,15 +140,82 @@ def default_cache_dir(
     return os.path.join(base, "_photon_cache", key)
 
 
-def list_source_files(paths: Sequence[str]) -> list[str]:
+def ingest_shard() -> tuple[int, int]:
+    """This process's disjoint ingest shard ``(index, count)``.
+
+    Under ``jax.distributed`` every process runs the same driver program
+    against the same input paths — without shard selection each one
+    decodes (or mmap-replays) the ENTIRE dataset and the cluster pays
+    ``num_processes ×`` the ingest bill for identical bytes. Resolution:
+    ``PHOTON_INGEST_SHARD`` env (``"i/n"``, the test/A-B lever and the
+    override for launchers that shard upstream; ``"off"`` disables
+    selection entirely) > the live ``jax.distributed`` process topology
+    (read from the already-initialized state only — probing must NEVER
+    initialize a backend) > ``(0, 1)`` (single process, no selection).
+
+    Contract boundary: shard-disjoint ingest pairs with PER-PROCESS
+    placement (each process materializes only the rows its own devices
+    own). A consumer that instead follows
+    ``parallel/distributed.distribute_batch``'s contract — identical
+    GLOBAL host data on every process, each slicing out its addressable
+    rows — must run with ``PHOTON_INGEST_SHARD=off``: feeding it
+    per-process-disjoint rows would make every process's "global" array
+    disagree."""
+    env = os.environ.get("PHOTON_INGEST_SHARD", "").strip()
+    if env.lower() == "off":
+        return 0, 1
+    if env:
+        idx_s, sep, n_s = env.partition("/")
+        try:
+            idx, n = int(idx_s), int(n_s)
+        except ValueError:
+            idx, n = -1, 0
+        if not sep or n < 1 or not (0 <= idx < n):
+            raise ValueError(
+                f"PHOTON_INGEST_SHARD must be 'i/n' with 0 <= i < n, "
+                f"got {env!r}"
+            )
+        return idx, n
+    try:
+        from jax._src import distributed
+
+        state = distributed.global_state
+        if state.client is not None and (state.num_processes or 0) > 1:
+            return int(state.process_id), int(state.num_processes)
+    except Exception:  # jax absent / private layout moved: single shard
+        pass
+    return 0, 1
+
+
+def list_source_files(
+    paths: Sequence[str], shard: tuple[int, int] | None = None
+) -> list[str]:
     """THE avro part-file enumeration for the cache layer (front door,
     writer fingerprinting, cache_tool) — one policy site, and resolve
     captures its result so the staleness verdict and a build-through's
     written fingerprint describe the SAME file list even if the
-    directory changes mid-run."""
+    directory changes mid-run.
+
+    ``shard=(i, n)`` selects this process's disjoint round-robin file
+    subset (``files[i::n]`` of the deterministic sorted enumeration) —
+    the per-process split under ``jax.distributed``. Selection happens
+    HERE, on the enumerated file list, so the cold avro path and the
+    warm cache path (whose directory key and source fingerprint both
+    derive from this list) split identically."""
     from photon_tpu.io.avro import avro_part_files
 
-    return [f for p in paths for f in avro_part_files(p)]
+    files = [f for p in paths for f in avro_part_files(p)]
+    if shard is None or shard[1] <= 1:
+        return files
+    idx, n = shard
+    selected = files[idx::n]
+    if not selected:
+        raise ValueError(
+            f"ingest shard {idx}/{n} selects 0 of {len(files)} part "
+            "files — fewer part files than processes; repartition the "
+            "input or run fewer processes"
+        )
+    return selected
 
 
 def _fallback(reason: str, detail: str) -> None:
@@ -375,6 +442,18 @@ def resolve_reader(
     or the avro path per the mode (see the module docstring)."""
     if isinstance(paths, (str, bytes)):
         paths = [paths]
+    shard = ingest_shard()
+    if shard[1] > 1:
+        # per-process shard-disjoint ingest under jax.distributed: from
+        # here on ``paths`` IS this process's file subset, so the cache
+        # directory key, the source fingerprint, the cold avro read and
+        # the warm mmap replay all describe the same disjoint rows —
+        # cold and warm paths split identically by construction
+        paths = list_source_files(paths, shard=shard)
+        logger.info(
+            "ingest shard %d/%d: %d part files", shard[0], shard[1],
+            len(paths),
+        )
     mode = cache_mode(mode)
     if mode == "off":
         return ResolvedReader(

@@ -134,6 +134,18 @@ def build_parser() -> argparse.ArgumentParser:
         "JSON for future jobs",
     )
     p.add_argument(
+        "--mesh",
+        default=None,
+        metavar="DxE|N|auto",
+        help="span the fit over a device mesh: 'DxE' (data x entity "
+        "device factorization, e.g. 1x8), 'N' (N devices on the data "
+        "axis), or 'auto' (every device on the data axis). Fixed-effect "
+        "batches shard rows over the whole mesh, random-effect entity "
+        "tables shard over the entity axis; checkpoints fingerprint the "
+        "topology. env PHOTON_MESH overrides; default off "
+        "(single-device)",
+    )
+    p.add_argument(
         "--precompile",
         action="store_true",
         help="AOT-compile the fused sweep/score programs on a thread pool "
@@ -457,11 +469,21 @@ def run(argv=None) -> dict:
                     args.model_input_directory, index_maps
                 )
 
+        from photon_tpu.parallel.mesh import resolve_mesh
+
+        mesh = resolve_mesh(args.mesh)
+        if mesh is not None:
+            log.info(
+                "training spans a %s device mesh (axes %s)",
+                "x".join(str(s) for s in mesh.devices.shape),
+                tuple(mesh.axis_names),
+            )
         estimator = GameEstimator(
             task=task,
             coordinate_configs=coordinate_configs,
             update_sequence=update_sequence,
             descent_iterations=args.coordinate_descent_iterations,
+            mesh=mesh,
             normalization_contexts=contexts,
             ignore_threshold_for_new_models=args.ignore_threshold_for_new_models,
             locked_coordinates=locked,

@@ -99,7 +99,16 @@ def checkpoint_keep(value: int | None = None) -> int:
 
 def _flatten_states(states: dict) -> dict[str, np.ndarray]:
     """coordinate states (Array | list[Array] | tuple[Array, ...]) →
-    flat {"cid/i": ndarray} mapping with a stable order."""
+    flat {"cid/i": ndarray} mapping with a stable order.
+
+    Mesh-sharded leaves (entity-sharded RE tables, replicated FE
+    coefficients) save through the same ``np.asarray``: on a
+    single-controller mesh every shard is addressable, so the fetch
+    assembles the GLOBAL array — the snapshot on disk is
+    topology-independent bytes, and only the estimator's fingerprint
+    (which hashes the mesh TOPOLOGY) decides what may resume it; the
+    loader re-places leaves onto the declared shardings
+    (``GameEstimator._place_states``)."""
     flat = {}
     for cid, state in states.items():
         if isinstance(state, (list, tuple)):

@@ -251,9 +251,14 @@ class Coordinate:
         must not pay (or, under ``PHOTON_SANITIZE=transfers``, trip on) a
         fresh implicit host→device transfer of the same Python float
         every step. λ-grid reweights change the value and simply miss
-        the one-entry cache; the array stays uncommitted (plain
+        the one-entry cache. Off-mesh the array stays uncommitted (plain
         ``jnp.asarray``) so both the AOT executables and the jit path
-        accept it unchanged."""
+        accept it unchanged; ON a mesh it is explicitly committed
+        replicated — an uncommitted scalar entering a meshed dispatch is
+        an implicit device-to-device broadcast EVERY STEP (the sanitizer
+        caught exactly this on the first end-to-end meshed fit), and
+        ``_scalar_sds`` lowers the AOT programs against the same
+        placement so they accept it."""
         cached = getattr(self, "_reg_scalar_cache", None)
         # phl-ok: PHL002 λ is a host config float (the cache key), never a device value
         v = float(value)
@@ -266,8 +271,23 @@ class Coordinate:
             "steady state"
         ):
             dev = jnp.asarray(value, self.dtype)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                dev = jax.device_put(dev, NamedSharding(self.mesh, P()))
         self._reg_scalar_cache = (v, dev)
         return dev
+
+    def _scalar_sds(self):
+        """ShapeDtypeStruct of a replicated 0-d scalar argument (λ),
+        carrying the mesh placement ``_reg_scalar`` commits to so the
+        AOT executables lower against the layout the run will use."""
+        sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(self.mesh, P())
+        return jax.ShapeDtypeStruct((), self.dtype, sharding=sharding)
 
     def spmd_contract(self):
         """Declared SPMD contract (photon_tpu/analysis/spmd.py) for this
@@ -279,6 +299,17 @@ class Coordinate:
         from photon_tpu.analysis import spmd
 
         return spmd.SpmdContract()
+
+    def place_state(self, state):
+        """Re-place a host/single-device state onto this coordinate's
+        DECLARED sharding (the layout ``initial_state`` and the state
+        ShapeDtypeStructs pin). Checkpoint resume and warm starts load
+        plain host arrays; handing them to the first meshed sweep as-is
+        would be an implicit reshard at dispatch (a transfer the
+        sanitizer flags) AND would reject the AOT executable on input
+        shardings — so the estimator routes every loaded state through
+        here. No-op off-mesh; subclasses override the mesh path."""
+        return state
 
     def to_model(self, state):
         raise NotImplementedError
@@ -423,15 +454,21 @@ class FixedEffectCoordinate(Coordinate):
 
     def initial_state(self) -> Array:
         z = jnp.zeros((self.num_features,), dtype=self.dtype)
-        if self.mesh is None:
-            return z
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         # place replicated ON THE MESH (the layout _state_sds declares):
         # a single-device zeros state would be implicitly resharded at
         # the first sweep dispatch (a transfer the sanitizer flags) and
         # would reject the AOT sweep executable's input shardings
-        return jax.device_put(z, NamedSharding(self.mesh, P()))
+        return self.place_state(z)
+
+    def place_state(self, state: Array) -> Array:
+        if self.mesh is None:
+            return state
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(
+            jnp.asarray(state, dtype=self.dtype),
+            NamedSharding(self.mesh, P()),
+        )
 
     def _norm_args(self) -> tuple:
         """Normalization factors/shifts as TRACED jit arguments. Reading
@@ -555,7 +592,7 @@ class FixedEffectCoordinate(Coordinate):
         row = self._row_sds(n, self.batch.labels)
         return self._active_sweep_jit(donate).lower(
             self, self.batch, self._norm_args(), row, row,
-            self._state_sds(), jax.ShapeDtypeStruct((), self.dtype),
+            self._state_sds(), self._scalar_sds(),
         )
 
     def _score_lowered(self):
@@ -809,25 +846,30 @@ class RandomEffectCoordinate(Coordinate):
         return self
 
     def initial_state(self) -> list[Array]:
-        put = lambda z: z  # noqa: E731
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from photon_tpu.parallel.mesh import ENTITY_AXIS
-
-            # entity-sharded like the live buckets and the state sds —
-            # single-device zeros would be implicitly resharded at the
-            # first sweep dispatch and reject the AOT executable
-            sh = NamedSharding(self.mesh, P(ENTITY_AXIS, None))
-            put = lambda z: jax.device_put(z, sh)  # noqa: E731
-        return [
-            put(
+        # entity-sharded like the live buckets and the state sds —
+        # single-device zeros would be implicitly resharded at the
+        # first sweep dispatch and reject the AOT executable
+        return self.place_state(
+            [
                 jnp.zeros(
                     (b.features.shape[0], b.features.shape[2]),
                     dtype=self.dtype,
                 )
-            )
-            for b in self.device_buckets
+                for b in self.device_buckets
+            ]
+        )
+
+    def place_state(self, state: list[Array]) -> list[Array]:
+        if self.mesh is None:
+            return state
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_tpu.parallel.mesh import ENTITY_AXIS
+
+        sh = NamedSharding(self.mesh, P(ENTITY_AXIS, None))
+        return [
+            jax.device_put(jnp.asarray(w, dtype=self.dtype), sh)
+            for w in state
         ]
 
     def _solve_bucket(
@@ -1097,7 +1139,7 @@ class RandomEffectCoordinate(Coordinate):
             row,
             self._state_sds_list(),
             self._pad_slots(),
-            jax.ShapeDtypeStruct((), self.dtype),
+            self._scalar_sds(),
         )
 
     def _score_lowered(self):
@@ -1313,20 +1355,22 @@ class MatrixFactorizationCoordinate(Coordinate):
         scale = self.config.init_scale / np.sqrt(k)
         u = rng.normal(scale=scale, size=(len(self.row_vocab), k))
         v = rng.normal(scale=scale, size=(len(self.col_vocab), k))
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+        # factor tables replicate ON THE MESH (see spmd_contract) —
+        # matching the per-sample columns' placement up front avoids
+        # an implicit reshard at the first sweep dispatch
+        return self.place_state(
+            (jnp.asarray(u, dtype=self.dtype), jnp.asarray(v, dtype=self.dtype))
+        )
 
-            # factor tables replicate ON THE MESH (see spmd_contract) —
-            # matching the per-sample columns' placement up front avoids
-            # an implicit reshard at the first sweep dispatch
-            rep = NamedSharding(self.mesh, P())
-            return (
-                jax.device_put(u.astype(jnp.dtype(self.dtype)), rep),
-                jax.device_put(v.astype(jnp.dtype(self.dtype)), rep),
-            )
-        return (
-            jnp.asarray(u, dtype=self.dtype),
-            jnp.asarray(v, dtype=self.dtype),
+    def place_state(self, state: tuple[Array, Array]) -> tuple[Array, Array]:
+        if self.mesh is None:
+            return state
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        return tuple(
+            jax.device_put(jnp.asarray(x, dtype=self.dtype), rep)
+            for x in state
         )
 
     def _train_body(
@@ -1459,7 +1503,7 @@ class MatrixFactorizationCoordinate(Coordinate):
         row = self._row_sds(self.labels.shape[0], self.labels)
         return self._active_sweep_jit(donate).lower(
             self, self._data_args(), row, row, self._state_sds_pair(),
-            jax.ShapeDtypeStruct((), self.dtype),
+            self._scalar_sds(),
         )
 
     def _score_lowered(self):

@@ -61,6 +61,45 @@ def _carry_over_prior_models(model: GameModel, initial: GameModel) -> GameModel:
     return dataclasses.replace(model, coordinates=merged)
 
 
+def shard_shape_census(coordinates, mesh) -> dict:
+    """Per-coordinate census of the meshed random-effect block layout —
+    the shard-uniformity contract behind the PR 3 shape budget on a
+    mesh: every bucket's entity axis must divide the entity shard count
+    so EVERY shard holds an identical ``(E/shards, rows, d)`` block and
+    all shards compile ONE shared bucket/level set (GSPMD partitions one
+    program; a shard-divergent block shape would force a repartition or
+    a per-shard program — exactly the compile-bill blowup the ShapePool
+    exists to prevent). Raises ``ValueError`` on divergence; returns
+    ``{cid: {"entity_shards", "per_shard_blocks", "levels"}}`` with the
+    shared ``(rows, d)`` level set per coordinate."""
+    from photon_tpu.game.coordinate import RandomEffectCoordinate
+    from photon_tpu.parallel.mesh import ENTITY_AXIS
+
+    shards = dict(mesh.shape).get(ENTITY_AXIS, 1)
+    census = {}
+    for cid, coord in coordinates.items():
+        if not isinstance(coord, RandomEffectCoordinate):
+            continue
+        blocks = []
+        levels = set()
+        for db in coord.device_buckets:
+            e, rows, d = (int(s) for s in db.features.shape)
+            if e % shards != 0:
+                raise ValueError(
+                    f"coordinate {cid}: bucket entity axis {e} does not "
+                    f"divide {shards} entity shards — shards would "
+                    "compile divergent block shapes"
+                )
+            blocks.append([e // shards, rows, d])
+            levels.add((rows, d))
+        census[cid] = {
+            "entity_shards": shards,
+            "per_shard_blocks": blocks,
+            "levels": sorted(levels),
+        }
+    return census
+
+
 @dataclasses.dataclass
 class GameTrainingResult:
     model: GameModel
@@ -149,11 +188,21 @@ class GameEstimator:
     #: this value (the env-over-config precedence every knob here
     #: follows); default 0: supervision off.
     max_restarts: int | None = None
+    #: retain the fit's built coordinates on ``last_coordinates`` after
+    #: ``fit`` returns — for audit tooling that inspects the fit's OWN
+    #: AOT executables and live table placements (the ``--programs``
+    #: estimator audit, bench's meshed leg, the northstar drive). OFF
+    #: by default: coordinates pin the entire on-device dataset (entity
+    #: blocks, the FE batch), and a long-lived estimator must not hold
+    #: the prior fit's footprint through its next phase.
+    keep_coordinates: bool = False
 
     def __post_init__(self):
         #: per-fit telemetry deltas (wall, dispatches, compiles) for the
         #: most recent ``fit()`` call — see the fit docstring
         self.last_fit_stats: dict | None = None
+        #: built coordinates of the most recent fit (audit tooling)
+        self.last_coordinates: dict | None = None
         missing = [c for c in self.update_sequence if c not in self.coordinate_configs]
         if missing:
             raise ValueError(f"update sequence names unknown coordinates: {missing}")
@@ -307,6 +356,7 @@ class GameEstimator:
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 1,
         shape_pool=None,
+        mesh=None,
     ) -> list[GameTrainingResult]:
         """Train one GameModel per λ-grid point, warm-starting across the
         grid (reference fit :304-390 + train :746).
@@ -341,8 +391,26 @@ class GameEstimator:
         that already profiled shapes — e.g. bench's projected-bill pass —
         don't pay the profile + DP twice and are guaranteed the fit
         buckets exactly as they priced.
+
+        ``mesh`` spans this fit over a device mesh (overriding the
+        constructor's ``mesh`` field for this call and onward): the
+        fixed-effect batch shards rows over EVERY mesh device, packed
+        random-effect entity tables shard over the entity axis
+        (``parallel/mesh.shard_entities``), and the fused sweep/score
+        programs compile against those shardings — PR 2's sync-free
+        steady state (one barrier per sweep, zero per-step re-placements)
+        survives on-mesh, gated by the transfer sanitizer and the SPMD
+        program audit. Checkpoints fingerprint the mesh TOPOLOGY (axis
+        names + shape), and a resume re-places loaded states onto each
+        coordinate's declared sharding.
         """
         from photon_tpu.util import compile_watch, dispatch_count
+
+        if mesh is not None:
+            # per-fit override of the constructor field: the mesh decides
+            # every placement the build performs, so it must be settled
+            # before the data/coordinate build below
+            self.mesh = mesh
 
         emitter = self.events
         t_fit = time.perf_counter()
@@ -473,6 +541,23 @@ class GameEstimator:
             coordinates, re_datasets = self._build_coordinates(
                 data, initial_model, shape_pool=shape_pool
             )
+        if self.mesh is not None:
+            # shard-uniformity contract (the PR 3 shape budget on a
+            # mesh): every shard must compile the SAME bucket/level set
+            # — divergence is a build bug, caught before any compile
+            census = shard_shape_census(coordinates, self.mesh)
+            for cid, row in census.items():
+                logger.info(
+                    "coordinate %s: %d entity shards × per-shard blocks "
+                    "%s (shared level set %s)",
+                    cid, row["entity_shards"], row["per_shard_blocks"],
+                    row["levels"],
+                )
+        # built coordinates retained only on request (keep_coordinates):
+        # audit tooling reads the fit's own AOT executables and live
+        # table placements from here; everyone else gets the device
+        # memory back when fit's locals drop
+        self.last_coordinates = coordinates if self.keep_coordinates else None
         # phase-boundary memory censuses (photon_tpu/obs/memory.py):
         # host-metadata snapshots of every live device buffer — gated
         # no-ops that never dispatch or read back
@@ -497,8 +582,11 @@ class GameEstimator:
         init_states = None
         if initial_model is not None:
             with obs.span("fit.warm_start"):
-                init_states = self._states_from_model(
-                    initial_model, coordinates, re_datasets
+                init_states = self._place_states(
+                    self._states_from_model(
+                        initial_model, coordinates, re_datasets
+                    ),
+                    coordinates,
                 )
             obs.memory.census("warm_start")
 
@@ -531,6 +619,8 @@ class GameEstimator:
                 re_shape_budget,
             )
 
+            from photon_tpu.parallel.mesh import mesh_fingerprint
+
             fingerprint = repr(
                 (
                     self.task,
@@ -543,6 +633,13 @@ class GameEstimator:
                     sorted(self.locked_coordinates),
                     self.seed,
                     data.num_samples,
+                    # mesh TOPOLOGY (axis names + per-axis device
+                    # counts): a checkpoint's saved leaves are laid out
+                    # for one topology (entity-sharded tables pad the
+                    # entity axis to divide it) — resuming under
+                    # another must be the clean stale-config error, not
+                    # a silent reshard or an unflatten failure
+                    mesh_fingerprint(self.mesh),
                     # layout knobs: a different bucket-entity cap or shape
                     # budget changes the per-bucket state SHAPES — resuming
                     # across either must be the clean stale-config error,
@@ -567,6 +664,16 @@ class GameEstimator:
                     ckpt.grid_index,
                     ckpt.iteration,
                 )
+                if self.mesh is not None:
+                    # the snapshot's leaves load as host arrays; the
+                    # first meshed dispatch must see the DECLARED
+                    # shardings, not pay an implicit reshard (which the
+                    # sanitizer flags and the AOT executables reject)
+                    ckpt.states = self._place_states(ckpt.states, coordinates)
+                    if ckpt.best_states is not None:
+                        ckpt.best_states = self._place_states(
+                            ckpt.best_states, coordinates
+                        )
 
         results = []
         states = init_states
@@ -688,6 +795,19 @@ class GameEstimator:
             },
             task=self.task,
         )
+
+    def _place_states(self, states: dict, coordinates) -> dict:
+        """Route every coordinate's loaded state through its declared
+        sharding (``Coordinate.place_state`` — explicit device_put, a
+        no-op off-mesh). One site for checkpoint resume AND warm starts,
+        so neither path can hand the meshed sweep a single-device
+        array."""
+        return {
+            cid: (
+                coordinates[cid].place_state(st) if cid in coordinates else st
+            )
+            for cid, st in states.items()
+        }
 
     def _states_from_model(self, model: GameModel, coordinates, re_datasets):
         """Warm-start / partial-retrain states from a prior GameModel

@@ -35,7 +35,20 @@ def initialize(
     process_id: int,
 ) -> None:
     """Join the multi-controller job (reference: Spark's executor
-    registration; here every process is a peer running the same program)."""
+    registration; here every process is a peer running the same program).
+
+    On the CPU platform the XLA client must be told to run cross-process
+    collectives over Gloo BEFORE the backend initializes — without it every
+    multi-device program spanning non-addressable devices dies with
+    "Multiprocess computations aren't implemented on the CPU backend"
+    (the exact failure tests/test_multihost.py pins). Set unconditionally:
+    the knob only affects CPU client creation (TPU/GPU collectives ride
+    ICI/NCCL regardless), and gating it on the platform being NAMED would
+    re-break the default-install CPU host where JAX_PLATFORMS is unset."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older jax: flag absent; initialize still works
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -54,7 +67,14 @@ def distribute_batch(batch, mesh: Mesh):
     only the addressable rows. ``batch`` holds host numpy arrays describing
     the GLOBAL data (deterministically reproducible on every process, or
     memory-mapped); the callback slices out each local shard. The field
-    mapping is ``parallel.mesh.shard_batch`` with a multi-host placement."""
+    mapping is ``parallel.mesh.shard_batch`` with a multi-host placement.
+
+    Ingest pairing: this contract requires IDENTICAL global data on every
+    process — a multi-process run feeding it from the cache front door
+    must set ``PHOTON_INGEST_SHARD=off``, because ``resolve_reader``'s
+    default under ``jax.distributed`` is per-process shard-DISJOINT file
+    subsets (``photon_tpu.cache.ingest_shard``), which pairs with
+    per-process-local placement, not with this global-slice one."""
 
     def put(x, sharding: NamedSharding):
         x = np.asarray(x)

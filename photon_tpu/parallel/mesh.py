@@ -89,6 +89,76 @@ def make_mesh(
     return Mesh(arr, (BATCH_AXIS, ENTITY_AXIS))
 
 
+def parse_mesh_spec(spec: str) -> tuple[int | None, int]:
+    """``--mesh`` / ``PHOTON_MESH`` spec → ``(num_data, num_entity)``.
+
+    Accepted forms (device counts, matching ``make_mesh``):
+
+    - ``"DxE"``  — explicit (data, entity) factorization, e.g. ``1x8``;
+    - ``"N"``    — N devices, all on the data axis (``num_entity=1``);
+    - ``"auto"`` — every available device, all on the data axis
+      (``num_data=None`` so ``make_mesh`` divides at call time);
+    - ``""`` / ``"off"`` / ``"none"`` / ``"0"`` — no mesh (callers get
+      ``None`` from :func:`resolve_mesh`).
+
+    Raises ``ValueError`` on anything else — a typo'd mesh spec must be
+    a loud config error, not a silent single-device run.
+    """
+    s = spec.strip().lower()
+    if s in ("", "off", "none", "0"):
+        raise ValueError("empty mesh spec (resolve_mesh handles disable)")
+    if s == "auto":
+        return None, 1
+    if "x" in s:
+        d_s, _, e_s = s.partition("x")
+        try:
+            d, e = int(d_s), int(e_s)
+        except ValueError:
+            raise ValueError(
+                f"mesh spec must be 'DxE', 'N', or 'auto', got {spec!r}"
+            ) from None
+        if d < 1 or e < 1:
+            raise ValueError(f"mesh factors must be >= 1, got {spec!r}")
+        return d, e
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"mesh spec must be 'DxE', 'N', or 'auto', got {spec!r}"
+        ) from None
+    if n < 1:
+        raise ValueError(f"mesh device count must be >= 1, got {spec!r}")
+    return n, 1
+
+
+def resolve_mesh(spec: str | None = None) -> Mesh | None:
+    """The mesh a training run spans: ``PHOTON_MESH`` env > explicit
+    ``spec`` (the ``--mesh`` flag) > no mesh (the repo-wide env-over-
+    config knob precedence). ``off``/``none``/``0``/empty disable.
+    Returns ``None`` off-mesh so callers thread it straight into
+    ``GameEstimator(mesh=...)``."""
+    import os
+
+    env = os.environ.get("PHOTON_MESH", "").strip()
+    s = env or (spec or "")
+    if s.strip().lower() in ("", "off", "none", "0"):
+        return None
+    num_data, num_entity = parse_mesh_spec(s)
+    return make_mesh(num_data=num_data, num_entity=num_entity)
+
+
+def mesh_fingerprint(mesh: Mesh | None) -> tuple | None:
+    """Stable topology description of a mesh for checkpoint fingerprints:
+    axis names + per-axis device counts. A checkpoint written under one
+    topology must not silently resume under another — the saved leaves'
+    layouts (entity-sharded tables, row-sharded totals) are declared per
+    topology, and a shape-compatible but differently-sharded resume
+    would re-place every leaf mid-descent. ``None`` off-mesh."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(int(s) for s in mesh.devices.shape))
+
+
 def shard_batch(batch, mesh: Mesh, put=None):
     """Place a batch with rows sharded over every mesh device (the feature
     dimension replicated). Rows spread over both axes so a fixed-effect solve

@@ -317,6 +317,84 @@ def judge_series_file(
     return v
 
 
+def judge_northstar(paths: list[str]) -> tuple[list[dict], list[str]]:
+    """The SCALE_NORTHSTAR_r*.json series as a gated trajectory: each
+    round's coefficient count, per-device footprint, padding waste and
+    leg (``coordinate`` = raw sharded train, ``estimator_e2e`` = the
+    full ``GameEstimator.fit(mesh=...)`` drive incl. checkpoint/
+    resume-place/score + SPMD audit). The NEWEST round must carry
+    ``ok: true`` — and a clean program audit when the leg ran one —
+    or the gate fails: the scale claim is only as good as its most
+    recent reproduction."""
+    rows: list[dict] = []
+    notes: list[str] = []
+    newest_name = os.path.splitext(os.path.basename(paths[-1]))[0]
+    for path in paths:
+        name = os.path.splitext(os.path.basename(path))[0]
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            if name == newest_name:
+                # the gate's whole contract is "the most recent
+                # reproduction holds" — a torn newest file must FAIL,
+                # not silently shift 'newest' to the previous round
+                notes.append(
+                    f"NORTHSTAR GATE: newest round {name} unreadable: {e}"
+                )
+            else:
+                notes.append(f"northstar {name} unreadable: {e}")
+            continue
+        ledger = doc.get("memory_ledger") or {}
+        rows.append(
+            {
+                "round": name,
+                "leg": doc.get("leg", "coordinate"),
+                "coefficients": doc.get("coefficients"),
+                "per_device_gib": ledger.get("per_device_gib"),
+                "fits_v5e": ledger.get("fits_v5e"),
+                "padding_waste": ledger.get("padding_waste"),
+                "audit_findings": (doc.get("audit") or {}).get("findings"),
+                "ok": bool(doc.get("ok")),
+            }
+        )
+    if rows:
+        newest = rows[-1]
+        if not newest["ok"]:
+            notes.append(
+                f"NORTHSTAR GATE: newest round {newest['round']} is not "
+                "ok — the scale claim has no current reproduction"
+            )
+        if newest.get("audit_findings"):
+            notes.append(
+                f"NORTHSTAR GATE: newest round {newest['round']} has "
+                f"{newest['audit_findings']} SPMD audit finding(s)"
+            )
+    return rows, notes
+
+
+def northstar_table(rows: list[dict]) -> str:
+    lines = ["== scale northstar (SCALE_NORTHSTAR_r*)"]
+    lines.append(
+        f"  {'round':<22} {'leg':<14} {'coefficients':>14} "
+        f"{'GiB/dev':>8} {'waste':>7} {'audit':>6} {'ok':>4}"
+    )
+    for r in rows:
+        coefs = r["coefficients"]
+        # format the number BEFORE padding: a ',' spec on the '-'
+        # placeholder string is a ValueError, not a table cell
+        coefs_s = f"{coefs:,}" if coefs is not None else "-"
+        lines.append(
+            f"  {r['round']:<22} {r['leg']:<14} "
+            f"{coefs_s:>14} "
+            f"{r['per_device_gib'] if r['per_device_gib'] is not None else '-':>8} "
+            f"{r['padding_waste'] if r['padding_waste'] is not None else '-':>7} "
+            f"{r['audit_findings'] if r['audit_findings'] is not None else '-':>6} "
+            f"{'yes' if r['ok'] else 'NO':>4}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -341,6 +419,12 @@ def main(argv=None) -> int:
         "comparable row (default 0.25)",
     )
     ap.add_argument("--out", default=None, help="write the trend JSON here")
+    ap.add_argument(
+        "--northstar",
+        default=os.path.join(_REPO_ROOT, "SCALE_NORTHSTAR_r*.json"),
+        help="glob of scale-northstar round files; the newest must be "
+        "ok (pass '' to skip)",
+    )
     ap.add_argument(
         "--series",
         default=None,
@@ -427,6 +511,17 @@ def main(argv=None) -> int:
             )
     failed_series = [v for v in series_verdicts if v["status"] == "fail"]
 
+    northstar_rows: list[dict] = []
+    northstar_notes: list[str] = []
+    if args.northstar:
+        ns_paths = sorted(glob.glob(args.northstar))
+        if ns_paths:
+            northstar_rows, northstar_notes = judge_northstar(ns_paths)
+            print(northstar_table(northstar_rows))
+            for note in northstar_notes:
+                print(f"[{'FAIL' if 'GATE' in note else 'warn'}] {note}")
+    failed_northstar = [n for n in northstar_notes if "GATE" in n]
+
     if args.out:
         doc = {
             "rounds": [e["round"] for e in entries],
@@ -443,12 +538,14 @@ def main(argv=None) -> int:
             "tolerance": args.tolerance,
             "within_run": series_verdicts,
             "series_tolerance": args.series_tolerance,
+            "northstar": northstar_rows,
+            "northstar_notes": northstar_notes,
         }
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)
         print(f"wrote trend document to {args.out}")
 
-    return 3 if (failed or failed_series) else 0
+    return 3 if (failed or failed_series or failed_northstar) else 0
 
 
 if __name__ == "__main__":

@@ -41,7 +41,11 @@ if _REPO_ROOT not in sys.path:  # runnable without an installed package
 
 
 def _build(args) -> int:
-    from photon_tpu.cache import default_cache_dir, list_source_files
+    from photon_tpu.cache import (
+        default_cache_dir,
+        ingest_shard,
+        list_source_files,
+    )
     from photon_tpu.cache.writer import FeatureCacheWriter
     from photon_tpu.cli.parsing import parse_feature_shard_config
     from photon_tpu.io.data_reader import AvroDataReader
@@ -58,6 +62,16 @@ def _build(args) -> int:
     paths = [
         p.strip() for p in args.input_data_directories.split(",") if p.strip()
     ]
+    shard = ingest_shard()
+    if shard[1] > 1:
+        # mirror the front door exactly: under an active ingest shard
+        # (PHOTON_INGEST_SHARD / jax.distributed) the cache this tool
+        # builds must carry the SAME per-shard file subset and directory
+        # key resolve_reader will look for — a full-set build here would
+        # key to a directory no sharded reader ever hits, making the
+        # require-mode error's pointed-at remedy a dead end
+        paths = list_source_files(paths, shard=shard)
+        print(f"ingest shard {shard[0]}/{shard[1]}: {len(paths)} part files")
     index_maps = None
     if args.off_heap_index_map_dir:
         from photon_tpu.data.native_index import load_partitioned_store

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Meshed GAME fit worker: one leg of the bench 1-vs-8 scaling A/B.
+
+Device count is fixed at process start (XLA reads
+``--xla_force_host_platform_device_count`` once, before backend init), so
+a same-machine mesh A/B needs one subprocess per device count — this is
+that subprocess. It runs the SAME deterministic FE + per-user-RE
+``GameEstimator.fit`` (structure and values from a fixed seed, f64 so
+the parity compare is tight) end-to-end on an ``1 × devices``
+(data × entity) mesh — train → checkpoint → score — under
+``PHOTON_SANITIZE=transfers``, and records into ``--out``:
+
+* ``steady_sweep_s`` / ``steady_compiles`` — the post-compile sweep wall
+  and any hot-loop retraces (must be 0);
+* ``comm_bytes_per_sweep`` — the SPMD communication census
+  (photon_tpu/analysis) priced over the fit's OWN sweep executables,
+  plus the audit's finding count (must be 0);
+* ``entity_table_bytes_per_device`` — max per-device bytes of the
+  random-effect entity blocks, from the live sharded arrays'
+  addressable shards (the ≈1/devices capacity claim, measured);
+* the trained coefficients (FE means + per-entity RE rows keyed by
+  entity) as an npz next to ``--out`` for the cross-leg parity compare.
+
+Invoked by ``bench._mesh_scaling_ab`` and usable standalone:
+    python scripts/mesh_fit_worker.py --devices 8 --out /tmp/leg8.json
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--devices", type=int, required=True)
+ap.add_argument("--out", required=True, help="result JSON path (.npz rides beside it)")
+ap.add_argument("--n", type=int, default=4096)
+ap.add_argument("--fe-dim", type=int, default=32)
+ap.add_argument("--users", type=int, default=512)
+ap.add_argument("--d-re", type=int, default=8)
+ap.add_argument("--upper-bound", type=int, default=64)
+ap.add_argument("--iters", type=int, default=3)
+ap.add_argument("--seed", type=int, default=0)
+ap.add_argument(
+    "--checkpoint-dir", default=None,
+    help="optional: checkpoint every sweep (the meshed save path)",
+)
+args = ap.parse_args()
+
+# platform pinned BEFORE any jax import side effect (conftest discipline)
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={args.devices}"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the hot loop must be clean under the transfer sanitizer ON the mesh —
+# an implicit per-step re-placement fails this worker, hence the leg
+os.environ.setdefault("PHOTON_SANITIZE", "transfers")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from photon_tpu.analysis.hlo import audit_coordinates  # noqa: E402
+from photon_tpu.game.config import (  # noqa: E402
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.coordinate import RandomEffectCoordinate  # noqa: E402
+from photon_tpu.game.data import (  # noqa: E402
+    CSRMatrix,
+    GameData,
+    re_shape_budget,
+)
+from photon_tpu.game.estimator import GameEstimator  # noqa: E402
+from photon_tpu.optimize.common import OptimizerConfig  # noqa: E402
+from photon_tpu.optimize.problem import (  # noqa: E402
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.parallel.mesh import make_mesh  # noqa: E402
+from photon_tpu.types import TaskType  # noqa: E402
+
+
+def build_data(rng, n, fe_dim, users, d_re):
+    """Deterministic Zipf-skewed GLMix data — BOTH legs build the exact
+    same rows from the same seed, so coefficient parity is meaningful."""
+    x = rng.normal(size=(n, fe_dim)).astype(np.float32)
+    margin = x @ (0.1 * rng.normal(size=fe_dim))
+    ranks = rng.zipf(1.6, size=n) % users
+    ids = [f"u{r}" for r in ranks]
+    x_re = rng.normal(size=(n, d_re)).astype(np.float32)
+    labels = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-margin))).astype(
+        np.float64
+    )
+    return GameData.build(
+        labels=labels,
+        feature_shards={
+            "global": CSRMatrix.from_dense(x),
+            "per_user": CSRMatrix.from_dense(x_re),
+        },
+        id_tags={"user": ids},
+    )
+
+
+def entity_table_bytes_per_device(coordinates) -> int:
+    """Max per-device bytes of the RE entity blocks, measured from the
+    live sharded arrays (every addressable shard attributed to its
+    device) — the number the ≈1/devices capacity claim stands on."""
+    per_device: dict = {}
+    for coord in coordinates.values():
+        if not isinstance(coord, RandomEffectCoordinate):
+            continue
+        for db in coord.device_buckets:
+            for arr in (
+                db.features, db.labels, db.offsets, db.train_weights,
+                db.sample_pos,
+            ):
+                for s in arr.addressable_shards:
+                    key = s.device.id
+                    per_device[key] = per_device.get(key, 0) + s.data.nbytes
+    return max(per_device.values()) if per_device else 0
+
+
+def main() -> None:
+    rng = np.random.default_rng(args.seed)
+    data = build_data(rng, args.n, args.fe_dim, args.users, args.d_re)
+    opt_re = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=5, ls_max_iterations=8),
+        regularization=RegularizationContext(RegularizationType.L2),
+    )
+    opt_fe = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(
+            max_iterations=10, ls_max_iterations=10
+        ),
+        regularization=RegularizationContext(RegularizationType.L2),
+    )
+    mesh = (
+        make_mesh(num_data=1, num_entity=args.devices)
+        if args.devices > 1
+        else None
+    )
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="global", optimization=opt_fe,
+                regularization_weights=(1.0,),
+            ),
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="user", feature_shard="per_user",
+                optimization=opt_re, regularization_weights=(1.0,),
+                active_data_upper_bound=args.upper_bound,
+            ),
+        },
+        update_sequence=["fixed", "user"],
+        descent_iterations=args.iters,
+        dtype=jnp.float64,
+        precompile=True,
+        keep_coordinates=True,  # audited + shard-measured post-fit
+    )
+    t0 = time.perf_counter()
+    results = est.fit(data, mesh=mesh, checkpoint_dir=args.checkpoint_dir)
+    fit_wall = time.perf_counter() - t0
+    result = results[0]
+
+    sweep_rows = [
+        r for r in result.tracker
+        if "sweep_seconds" in r and "coordinate" not in r
+    ]
+    steady = sweep_rows[1:] or sweep_rows
+    steady_sweep_s = min(r["sweep_seconds"] for r in steady)
+    steady_compiles = sum(r["compiles"] for r in sweep_rows[1:])
+
+    report = audit_coordinates(
+        est.last_coordinates, shape_budget=re_shape_budget(None)
+    )
+    comm_bytes_per_sweep = sum(
+        row["comm_bytes"] for row in report.comm
+        if row["program"].endswith(("sweep:True", "sweep:False"))
+    )
+
+    # coefficients for the cross-leg parity compare: FE means + RE rows
+    # keyed by entity (the meshed build permutes entities shard-major,
+    # so positional compare is meaningless — key by entity id)
+    model = result.model
+    fe = np.asarray(model.coordinates["fixed"].model.coefficients.means)
+    re_model = model.coordinates["user"]
+    lookup = re_model.dense_coefficient_lookup()
+    re_keys = np.asarray(re_model.vocab)
+    order = np.argsort(re_keys)
+    npz_path = args.out + ".npz"
+    np.savez(
+        npz_path,
+        fe=fe,
+        re_keys=re_keys[order],
+        re_coefs=np.asarray(lookup)[order],
+    )
+
+    out = {
+        "devices": args.devices,
+        "mesh_shape": (
+            "x".join(str(s) for s in mesh.devices.shape) if mesh else "1"
+        ),
+        "n": args.n,
+        "users": args.users,
+        "fit_wall_s": round(fit_wall, 3),
+        "steady_sweep_s": round(steady_sweep_s, 5),
+        "steady_compiles": int(steady_compiles),
+        "comm_bytes_per_sweep": int(comm_bytes_per_sweep),
+        "audit_findings": len(report.findings),
+        "entity_table_bytes_per_device": entity_table_bytes_per_device(
+            est.last_coordinates
+        ),
+        "sanitize": os.environ.get("PHOTON_SANITIZE", ""),
+        "coeffs_npz": npz_path,
+        "checkpointed": bool(args.checkpoint_dir),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -126,3 +126,41 @@ def test_bands_cover_every_config():
     deciding its quality contract should fail loudly here."""
     for name, _, _ in bench.CONFIG_PLAN:
         assert name in bench.QUALITY_BANDS, name
+
+
+def test_mesh_scaling_band_semantics():
+    """The meshed 1-vs-8 A/B bands (ROADMAP 1): a healthy section
+    passes; a missing section, a parity blowup, a steady-state retrace,
+    an audit finding, or unsharded tables each fail — a published
+    scaling row with any of those is a capacity claim with no evidence."""
+    base = {
+        "scale": "smoke",
+        "grouped_auc": {"value": 0.9},
+        "mem": {"peak_bytes": 1 << 20, "exec_temp_bytes": 1 << 10},
+        "cache": {"parity_max_abs": 0.0, "warm_decode_spans": 0},
+    }
+    healthy_mesh = {
+        "parity_max_abs": 1e-13,
+        "steady_compiles": 0,
+        "audit_findings": 0,
+        "table_shard_ratio": 5.3,
+    }
+    ok = dict(base, mesh=dict(healthy_mesh))
+    assert bench.check_quality_bands("glmix_game_estimator", ok) == []
+    for poison, needle in (
+        ({"parity_max_abs": 1e-3}, "parity"),
+        ({"parity_max_abs": float("nan")}, "parity"),
+        ({"steady_compiles": 2}, "retrace"),
+        ({"audit_findings": 1}, "audit"),
+        ({"table_shard_ratio": 1.01}, "not actually sharded"),
+    ):
+        detail = dict(base, mesh=dict(healthy_mesh, **poison))
+        violations = bench.check_quality_bands(
+            "glmix_game_estimator", detail
+        )
+        assert any(needle in v for v in violations), (poison, violations)
+    # absent section and failed worker both fail
+    assert bench.check_quality_bands("glmix_game_estimator", dict(base))
+    assert bench.check_quality_bands(
+        "glmix_game_estimator", dict(base, mesh={"error": "worker died"})
+    )

@@ -731,3 +731,78 @@ def test_scoring_driver_warm_cache_end_to_end(tmp_path, monkeypatch):
     assert summary2["scoring"]["featureCache"]["state"] == "hit"
     assert summary2["scoring"]["featureCache"]["source"] == "cache"
     np.testing.assert_array_equal(r2["scores"], r1["scores"])
+
+
+# --- per-process shard-disjoint ingest (jax.distributed) -------------------
+
+
+def test_ingest_shard_env_validation(monkeypatch):
+    from photon_tpu.cache import ingest_shard
+
+    monkeypatch.delenv("PHOTON_INGEST_SHARD", raising=False)
+    assert ingest_shard() == (0, 1)
+    monkeypatch.setenv("PHOTON_INGEST_SHARD", "1/3")
+    assert ingest_shard() == (1, 3)
+    # "off" force-disables selection even under a live jax.distributed
+    # topology — the escape distribute_batch's global-data contract needs
+    monkeypatch.setenv("PHOTON_INGEST_SHARD", "off")
+    assert ingest_shard() == (0, 1)
+    for bad in ("3/3", "-1/2", "2", "a/b", "1/0"):
+        monkeypatch.setenv("PHOTON_INGEST_SHARD", bad)
+        with pytest.raises(ValueError, match="PHOTON_INGEST_SHARD"):
+            ingest_shard()
+
+
+def test_shard_disjoint_cold_avro_reads(dataset, monkeypatch):
+    """Two ingest shards must decode DISJOINT part-file subsets whose
+    union is the full dataset — instead of each process replaying
+    everything."""
+    d, ref, maps = dataset
+    datas = []
+    for i in range(2):
+        monkeypatch.setenv("PHOTON_INGEST_SHARD", f"{i}/2")
+        r = resolve_reader(d, SHARDS, index_maps=maps, id_tags=TAGS)
+        assert len(r.paths) < 5  # a strict subset of the 5 part files
+        datas.append(r.read())
+    monkeypatch.delenv("PHOTON_INGEST_SHARD")
+    total = sum(x.num_samples for x in datas)
+    assert total == ref.num_samples
+    # disjoint AND complete: the two shards' uids partition the full set
+    uids = [u for x in datas for u in x.uids]
+    assert sorted(u for u in uids if u) == sorted(
+        u for u in ref.uids if u
+    )
+
+
+def test_shard_disjoint_warm_cache_splits_identically(dataset, monkeypatch):
+    """The warm mmap replay must hand each process the SAME disjoint
+    rows the cold avro read gave it: shard selection routes through
+    ``list_source_files`` before the cache key / fingerprint, so each
+    shard builds and replays its OWN cache."""
+    d, _, maps = dataset
+    for i in range(2):
+        monkeypatch.setenv("PHOTON_INGEST_SHARD", f"{i}/2")
+        cold = resolve_reader(
+            d, SHARDS, index_maps=maps, id_tags=TAGS, mode="rebuild"
+        )
+        cold_data = cold.read()
+        warm = resolve_reader(
+            d, SHARDS, index_maps=maps, id_tags=TAGS, mode="require"
+        )
+        assert warm.state == "hit"
+        _assert_game_data_equal(cold_data, warm.read())
+        # the two shards' caches are distinct directories (disjoint keys)
+        if i == 0:
+            dir0 = cold.cache_dir
+        else:
+            assert cold.cache_dir != dir0
+
+
+def test_shard_with_fewer_files_than_processes_fails_loudly(
+    dataset, monkeypatch
+):
+    from photon_tpu.cache import list_source_files
+
+    d, _, _ = dataset
+    with pytest.raises(ValueError, match="0 of 5 part files"):
+        list_source_files([d], shard=(5, 6))

@@ -995,3 +995,51 @@ def test_feature_stats_avro_output(avro_data, tmp_path):
     }
     # variance sanity: nonnegative everywhere
     assert all(rec["metrics"]["variance"] >= 0 for rec in recs)
+
+
+def test_training_driver_mesh_flag_end_to_end(avro_data, tmp_path):
+    """`--mesh 1x8` spans the DRIVER's fit over the virtual 8-device
+    mesh end-to-end (FE + per-user RE), and the trained model matches
+    the single-device driver run per coefficient — the CLI face of
+    tests/test_mesh_fit.py's estimator-level parity pin."""
+    import numpy as np
+
+    def train(out, extra):
+        return game_training.run(
+            [
+                "--input-data-directories", str(avro_data / "train"),
+                "--root-output-directory", str(out),
+                "--training-task", "LOGISTIC_REGRESSION",
+                "--feature-shard-configurations", SHARD_ARG,
+                "--coordinate-configurations",
+                "name=global,feature.shard=global,optimizer=LBFGS,"
+                "max.iter=10,regularization=L2,reg.weights=1",
+                "--coordinate-configurations",
+                "name=per-user,random.effect.type=userId,"
+                "feature.shard=global,max.iter=5,regularization=L2,"
+                "reg.weights=1",
+                "--coordinate-update-sequence", "global,per-user",
+                "--coordinate-descent-iterations", "2",
+                *extra,
+            ]
+        )
+
+    res_single = train(tmp_path / "t1", [])
+    res_mesh = train(tmp_path / "t8", ["--mesh", "1x8"])
+    m1 = res_single["results"][0].model
+    m8 = res_mesh["results"][0].model
+    f1 = np.asarray(m1.coordinates["global"].model.coefficients.means)
+    f8 = np.asarray(m8.coordinates["global"].model.coefficients.means)
+    # the driver fits at f32: cross-device reduction order moves
+    # coefficients at the 1e-4 level (the f64 tight pin lives in
+    # tests/test_mesh_fit.py)
+    np.testing.assert_allclose(f1, f8, rtol=0, atol=2e-3)
+    re1, re8 = m1.coordinates["per-user"], m8.coordinates["per-user"]
+    l1, l8 = re1.dense_coefficient_lookup(), re8.dense_coefficient_lookup()
+    i1 = {k: i for i, k in enumerate(re1.vocab)}
+    i8 = {k: i for i, k in enumerate(re8.vocab)}
+    assert set(i1) == set(i8)
+    for k in i1:
+        np.testing.assert_allclose(
+            l1[i1[k]], l8[i8[k]], rtol=0, atol=2e-3
+        )

@@ -1,0 +1,382 @@
+"""Sharded multi-device GAME training: the end-to-end meshed fit.
+
+ROADMAP item 1: ``GameEstimator.fit(mesh=...)`` spans an actual fit over
+the 8-virtual-device CPU mesh (conftest) — fixed-effect rows sharded over
+the whole mesh, packed random-effect entity tables entity-sharded — and
+these tests pin the contracts the PR 9 audits only checked statically:
+
+* coefficient parity vs the single-device fit (f64, per-entity keyed —
+  the meshed build permutes entities shard-major);
+* zero steady-state compiles and PR 2's sync-free dispatch profile, with
+  the whole meshed fit running under ``PHOTON_SANITIZE=transfers``;
+* one SHARED bucket/level set across shards (the PR 3 shape budget on a
+  mesh) — identical to the single-device level set;
+* meshed checkpoints: entity-sharded leaves save/load, the mesh TOPOLOGY
+  rides the fingerprint (resuming under another topology is the clean
+  stale-config error), resume re-places states onto declared shardings,
+  and the PR 10 chaos leg (injected transient fault + supervised
+  auto-resume) is bit-exact vs the uninterrupted meshed run;
+* train → checkpoint → resume → score end-to-end: the meshed model
+  scores through the streaming engine.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator, shard_shape_census
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.parallel.mesh import (
+    ENTITY_AXIS,
+    make_mesh,
+    mesh_fingerprint,
+    parse_mesh_spec,
+    resolve_mesh,
+)
+from photon_tpu.types import TaskType
+from photon_tpu.util import faults
+from photon_tpu.util.faults import InjectedFault
+
+N, FE_DIM, USERS, D_RE = 512, 12, 40, 6
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-virtual-device platform"
+)
+
+
+def _game_data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N, FE_DIM)).astype(np.float32)
+    margin = x @ (0.2 * rng.normal(size=FE_DIM))
+    ids = rng.integers(0, USERS, size=N)
+    return GameData.build(
+        labels=(rng.uniform(size=N) < 1 / (1 + np.exp(-margin))).astype(
+            np.float64
+        ),
+        feature_shards={
+            "global": CSRMatrix.from_dense(x),
+            "per_user": CSRMatrix.from_dense(
+                rng.normal(size=(N, D_RE)).astype(np.float32)
+            ),
+        },
+        id_tags={"user": [f"u{i}" for i in ids]},
+    )
+
+
+def _estimator(mesh=None, max_restarts=None, iters=3):
+    opt = GLMProblemConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=4, ls_max_iterations=8),
+        regularization=RegularizationContext(RegularizationType.L2),
+    )
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="global", optimization=opt,
+                regularization_weights=(1.0,),
+            ),
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="user", feature_shard="per_user",
+                optimization=opt, regularization_weights=(1.0,),
+                active_data_upper_bound=16,
+            ),
+        },
+        update_sequence=["fixed", "user"],
+        descent_iterations=iters,
+        dtype=jnp.float64,
+        precompile=True,
+        mesh=mesh,
+        max_restarts=max_restarts,
+        keep_coordinates=True,  # the tests inspect live placements
+    )
+
+
+def _re_lookup(model, cid="user"):
+    """entity key → coefficient row (the meshed build permutes entities
+    shard-major, so positional compare across builds is meaningless)."""
+    cm = model.coordinates[cid]
+    lookup = cm.dense_coefficient_lookup()
+    return {k: np.asarray(lookup[i]) for i, k in enumerate(cm.vocab)}
+
+
+def _assert_models_equal(a, b, atol=0.0):
+    fa = np.asarray(a.coordinates["fixed"].model.coefficients.means)
+    fb = np.asarray(b.coordinates["fixed"].model.coefficients.means)
+    np.testing.assert_allclose(fa, fb, rtol=0, atol=atol)
+    la, lb = _re_lookup(a), _re_lookup(b)
+    assert set(la) == set(lb)
+    for k in la:
+        np.testing.assert_allclose(la[k], lb[k], rtol=0, atol=atol)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(num_data=1, num_entity=8)
+
+
+@pytest.fixture(scope="module")
+def single_fit():
+    est = _estimator()
+    results = est.fit(_game_data())
+    return est, results[0]
+
+
+@pytest.fixture(scope="module")
+def meshed_fit(mesh):
+    """THE meshed fit, run once per module UNDER the transfer sanitizer:
+    any implicit host transfer or per-step re-placement in the on-mesh
+    steady state fails every dependent test loudly."""
+    old = os.environ.get("PHOTON_SANITIZE")
+    os.environ["PHOTON_SANITIZE"] = "transfers"
+    try:
+        est = _estimator()
+        results = est.fit(_game_data(), mesh=mesh)
+    finally:
+        if old is None:
+            os.environ.pop("PHOTON_SANITIZE", None)
+        else:
+            os.environ["PHOTON_SANITIZE"] = old
+    return est, results[0]
+
+
+# --- parity + steady-state contracts ----------------------------------
+
+
+def test_meshed_fit_matches_single_device(single_fit, meshed_fit):
+    """Entity blocks are embarrassingly parallel (PAPER §L4/L5): the
+    8-device fit must reproduce the single-device coefficients to f64
+    reduction-order tolerance, per entity."""
+    _assert_models_equal(single_fit[1].model, meshed_fit[1].model, atol=1e-9)
+
+
+def test_fit_mesh_kwarg_overrides_constructor(mesh):
+    est = _estimator()  # constructed OFF-mesh
+    assert est.mesh is None
+    est.fit(_game_data(), mesh=mesh)
+    assert est.mesh is mesh
+    for coord in est.last_coordinates.values():
+        assert coord.mesh is mesh
+
+
+def test_meshed_steady_state_zero_compiles_sync_free(meshed_fit):
+    """PR 2's steady-state contract survives on-mesh: after the first
+    sweep, zero backend compiles (no retraces, no re-lowers) and the
+    fused profile of one program per coordinate per sweep with ONE
+    read-back barrier."""
+    _, result = meshed_fit
+    sweep_rows = [
+        r for r in result.tracker
+        if "sweep_seconds" in r and "coordinate" not in r
+    ]
+    assert len(sweep_rows) >= 2
+    for row in sweep_rows[1:]:
+        assert row["compiles"] == 0, row
+        # donation is off on XLA:CPU, so a steady sweep dispatches
+        # exactly one fused program per coordinate — nothing else
+        assert row["dispatches"] == 2, row
+        assert row["granularity"] == "sweep"
+
+
+def test_meshed_entity_tables_actually_shard(meshed_fit, mesh):
+    """Every RE entity block must be entity-sharded on device: one
+    device's addressable shard holds 1/8 of the entity axis — the
+    capacity story behind the hundreds-of-billions claim."""
+    est, _ = meshed_fit
+    coord = est.last_coordinates["user"]
+    for db in coord.device_buckets:
+        e = db.features.shape[0]
+        shards = db.features.addressable_shards
+        assert len(shards) == 8
+        for s in shards:
+            assert s.data.shape[0] == e // 8
+
+
+# --- the ShapePool / shared-level-set contract ------------------------
+
+
+def test_meshed_level_set_matches_single_device(single_fit, meshed_fit, mesh):
+    """All shards of a meshed fit compile ONE shared bucket/level set —
+    and it is the SAME (rows, d) level set the single-device build
+    compiles: the mesh must not change the shape bill."""
+    est_s, _ = single_fit
+    est_m, _ = meshed_fit
+
+    def levels(est):
+        return sorted(
+            {
+                (int(db.features.shape[1]), int(db.features.shape[2]))
+                for db in est.last_coordinates["user"].device_buckets
+            }
+        )
+
+    assert levels(est_s) == levels(est_m)
+    census = shard_shape_census(est_m.last_coordinates, mesh)
+    assert census["user"]["levels"] == levels(est_m)
+    # per-shard blocks are uniform: entity axes divide the shard count
+    for e_loc, rows, d in census["user"]["per_shard_blocks"]:
+        assert e_loc >= 1
+
+
+def test_shard_shape_census_rejects_divergent_blocks(mesh):
+    from photon_tpu.game.coordinate import RandomEffectCoordinate
+
+    class FakeBucket:
+        def __init__(self, shape):
+            self.features = np.zeros(shape, dtype=np.float32)
+
+    coord = object.__new__(RandomEffectCoordinate)
+    coord.device_buckets = [FakeBucket((13, 4, 8))]  # 13 % 8 != 0
+    with pytest.raises(ValueError, match="does not divide"):
+        shard_shape_census({"re": coord}, mesh)
+
+
+# --- meshed checkpoint / resume ---------------------------------------
+
+
+def test_meshed_checkpoint_resume_bit_exact(tmp_path, mesh):
+    """PR 10 chaos leg ON the mesh: a transient fault at sweep 2 kills
+    the fit, the supervisor restarts it, the resume loads the
+    entity-sharded leaves from disk, re-places them onto the declared
+    shardings, and the final model is BIT-EXACT vs the uninterrupted
+    meshed run."""
+    data = _game_data(seed=2)
+    baseline = _estimator().fit(data, mesh=mesh)[0]
+    with faults.injected("descent.sweep@2=unavailable"):
+        res = _estimator(max_restarts=1).fit(
+            data, mesh=mesh, checkpoint_dir=str(tmp_path / "ckpt")
+        )[0]
+    _assert_models_equal(baseline.model, res.model, atol=0.0)
+
+
+def test_meshed_resume_replaces_states_on_declared_shardings(
+    tmp_path, mesh
+):
+    """The loaded snapshot's leaves are host arrays; ``_place_states``
+    must hand the first meshed sweep entity-sharded / replicated arrays
+    matching each coordinate's declared layout."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_tpu.game.checkpoint import DescentCheckpointer
+
+    data = _game_data(seed=3)
+    est = _estimator()
+    est.fit(data, mesh=mesh, checkpoint_dir=str(tmp_path / "ckpt"))
+    ckpt = DescentCheckpointer(str(tmp_path / "ckpt")).load()
+    assert ckpt is not None
+    placed = est._place_states(ckpt.states, est.last_coordinates)
+    ent = NamedSharding(mesh, P(ENTITY_AXIS, None))
+    for leaf in placed["user"]:
+        assert leaf.sharding.is_equivalent_to(ent, leaf.ndim)
+    rep = NamedSharding(mesh, P())
+    assert placed["fixed"].sharding.is_equivalent_to(rep, 1)
+
+
+def test_mesh_topology_rides_the_checkpoint_fingerprint(tmp_path, mesh):
+    """A checkpoint written under one mesh topology must refuse to
+    resume under another — the leaves' declared layouts differ."""
+    data = _game_data(seed=4)
+    ckpt_dir = str(tmp_path / "ckpt")
+    _estimator().fit(data, mesh=mesh, checkpoint_dir=ckpt_dir)
+    with pytest.raises(ValueError, match="different training configuration"):
+        _estimator().fit(data, checkpoint_dir=ckpt_dir)  # no mesh
+
+
+def test_mesh_fingerprint_units(mesh):
+    assert mesh_fingerprint(None) is None
+    fp = mesh_fingerprint(mesh)
+    assert fp == (("data", "entity"), (1, 8))
+    assert mesh_fingerprint(make_mesh(num_data=8, num_entity=1)) != fp
+
+
+# --- end-to-end: train -> checkpoint -> resume -> score ---------------
+
+
+def test_meshed_train_checkpoint_resume_score_end_to_end(tmp_path, mesh):
+    """The acceptance drive in miniature: the meshed fit checkpoints,
+    an injected fault forces a mid-descent resume, and the resulting
+    model scores through the streaming engine with sane outputs."""
+    from photon_tpu.game.scoring import GameScorer
+
+    data = _game_data(seed=5)
+    with faults.injected("descent.sweep@2=unavailable"):
+        res = _estimator(max_restarts=1).fit(
+            data, mesh=mesh, checkpoint_dir=str(tmp_path / "ckpt")
+        )[0]
+    scores = GameScorer(res.model, batch_rows=128).score_data(data)
+    assert scores.shape == (N,)
+    assert np.all(np.isfinite(scores))
+    # the model must actually separate the classes it was fit on
+    labels = np.asarray(data.labels)
+    pos, neg = scores[labels > 0.5], scores[labels <= 0.5]
+    auc = (pos[:, None] > neg[None, :]).mean()
+    assert auc > 0.6, auc
+
+
+# --- mesh spec / resolve units ----------------------------------------
+
+
+def test_parse_mesh_spec_units():
+    assert parse_mesh_spec("1x8") == (1, 8)
+    assert parse_mesh_spec("8") == (8, 1)
+    assert parse_mesh_spec("auto") == (None, 1)
+    for bad in ("x8", "8x", "1x0", "-2", "axb"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+
+def test_resolve_mesh_env_wins(monkeypatch):
+    monkeypatch.delenv("PHOTON_MESH", raising=False)
+    assert resolve_mesh(None) is None
+    assert resolve_mesh("off") is None
+    m = resolve_mesh("1x8")
+    assert dict(m.shape) == {"data": 1, "entity": 8}
+    monkeypatch.setenv("PHOTON_MESH", "off")
+    assert resolve_mesh("1x8") is None
+    monkeypatch.setenv("PHOTON_MESH", "8x1")
+    m = resolve_mesh(None)
+    assert dict(m.shape) == {"data": 8, "entity": 1}
+    monkeypatch.setenv("PHOTON_MESH", "bogus")
+    with pytest.raises(ValueError):
+        resolve_mesh(None)
+
+
+def test_training_driver_exposes_mesh_flag():
+    from photon_tpu.cli.game_training import build_parser
+
+    args = build_parser().parse_args(
+        [
+            "--input-data-directories", "/x",
+            "--root-output-directory", "/y",
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", "name=g,feature.bags=features",
+            "--coordinate-configurations",
+            "name=g,feature.shard=g,optimizer=LBFGS,regularization=L2,"
+            "reg.weights=1",
+            "--coordinate-update-sequence", "g",
+            "--mesh", "1x8",
+        ]
+    )
+    assert args.mesh == "1x8"
+
+
+def test_injected_fault_without_budget_raises(tmp_path, mesh):
+    """Guard the chaos leg's premise: without a restart budget the
+    injected fault propagates (the supervisor, not luck, recovers)."""
+    data = _game_data(seed=2)
+    with faults.injected("descent.sweep@2=unavailable"):
+        with pytest.raises(InjectedFault):
+            _estimator().fit(
+                data, mesh=mesh, checkpoint_dir=str(tmp_path / "c")
+            )

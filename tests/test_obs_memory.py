@@ -447,6 +447,18 @@ def _load_script(name):
     return mod
 
 
+#: a healthy meshed 1-vs-8 A/B section (bench._mesh_scaling_ab row) —
+#: the glmix bands require it, like the cache section: a published row
+#: with the mesh leg silently missing is a capacity claim with no
+#: evidence behind it
+_HEALTHY_MESH = {
+    "parity_max_abs": 1e-13,
+    "steady_compiles": 0,
+    "audit_findings": 0,
+    "table_shard_ratio": 5.3,
+}
+
+
 def test_quality_band_requires_memory_columns():
     from bench import check_quality_bands
 
@@ -455,6 +467,7 @@ def test_quality_band_requires_memory_columns():
         "grouped_auc": {"value": 0.9},
         "mem": {"peak_bytes": 123456, "exec_temp_bytes": 789},
         "cache": {"parity_max_abs": 0.0, "warm_decode_spans": 0},
+        "mesh": dict(_HEALTHY_MESH),
     }
     assert check_quality_bands("glmix_game_estimator", healthy) == []
     for broken in (
@@ -488,6 +501,7 @@ def _cfg(eps, backend="cpu", scale="smoke", **extra):
         "grouped_auc": {"value": 0.9},
         "mem": {"peak_bytes": 1000, "exec_temp_bytes": 10},
         "cache": {"parity_max_abs": 0.0, "warm_decode_spans": 0},
+        "mesh": dict(_HEALTHY_MESH),
         **extra,
     }
 
