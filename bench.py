@@ -172,7 +172,7 @@ SMOKE = os.environ.get("BENCH_SMOKE", "") == "1"
 #:             live high-watermark, ``mem.exec_temp_bytes`` XLA scratch
 #:             across the AOT executables, H2D/D2H bytes) — capacity
 #:             claims become measured columns, gated by QUALITY_BANDS.
-METRIC_VERSION = 4
+METRIC_VERSION = 5
 
 #: Per-config quality bands (VERDICT r5 next #6): a config that produces
 #: a throughput number while its MODEL is garbage must FAIL, not publish.
@@ -208,6 +208,14 @@ QUALITY_BANDS = {
         "mesh_steady_compiles_max": 0,
         "mesh_audit_findings_max": 0,
         "mesh_table_shard_ratio_min": 3.0,
+        # fleet leg (ISSUE 14): a healthy 2-process Gloo meshed fit must
+        # not flag any straggler — per-sweep barrier-arrival skew above
+        # the threshold means one worker is dragging the collective, the
+        # regression every later mesh-perf PR must not introduce. The
+        # ratio band is the straggler threshold itself (metric_version 5
+        # rows carry mesh.fleet.* + the device-time breakdown fields)
+        "fleet_max_skew_ratio_max": 2.0,
+        "fleet_stragglers_max": 0,
     },
     "game_ctr_scale": {
         "grouped_auc_min": {"smoke": 0.55, "cpu": 0.8, "tpu": 0.8},
@@ -322,6 +330,32 @@ def check_quality_bands(name: str, detail: dict) -> list[str]:
                     f"{ratio_min} — the meshed tables are not actually "
                     "sharded"
                 )
+            skew_max = band.get("fleet_max_skew_ratio_max")
+            # presence-gated: rows from before the fleet leg existed
+            # (metric_version <= 4 history, legacy fixtures) carry no
+            # "fleet" section and must keep passing; any row that RAN
+            # the leg — including a failed one — is fully gated
+            if skew_max is not None and "fleet" in mesh:
+                fleet = mesh.get("fleet") or {}
+                if fleet.get("error"):
+                    out.append(
+                        f"fleet leg failed: {fleet['error'][:300]}"
+                    )
+                else:
+                    sk = fleet.get("max_skew_ratio")
+                    if sk is None or not math.isfinite(sk) or sk > skew_max:
+                        out.append(
+                            f"fleet per-sweep skew ratio {sk} > {skew_max} "
+                            "— one worker is dragging the meshed sweep "
+                            "(straggler regression)"
+                        )
+                    strag_max = band.get("fleet_stragglers_max", 0)
+                    n_strag = len(fleet.get("stragglers") or [])
+                    if n_strag > strag_max:
+                        out.append(
+                            f"fleet leg flagged {n_strag} straggler(s) "
+                            f"(> {strag_max}) in a healthy run"
+                        )
     if band.get("require_memory"):
         mem = detail.get("mem") or {}
         peak = mem.get("peak_bytes")
@@ -1267,6 +1301,118 @@ def _cache_ingest_ab(data, max_rows=16384):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _mesh_fleet_leg(worker, tmpdir, n, users):
+    """The 2-process Gloo fleet leg of the mesh A/B (ISSUE 14): the SAME
+    deterministic fit spans a 2-process × 2-virtual-device global mesh
+    under ``jax.distributed`` with the fleet telemetry plane armed —
+    per-process ``obs/p<k>`` artifacts, heartbeat snapshots, the
+    per-sweep barrier-arrival log. The returned detail carries the
+    per-sweep skew series (max skew ratio is band-gated: a healthy run
+    flags ZERO stragglers) and the device-time
+    compute / collectives / barrier breakdown the fit published from
+    its own executables' comm census + cost-model flops."""
+    import socket
+
+    def _port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    coord_port = _port()
+    out_root = os.path.join(tmpdir, "fleet_run")
+    procs = []
+    log_paths = []
+    #: ambient fleet/obs knobs must not reach the workers (the repo's
+    #: pin-ambient-env-out discipline): an exported PHOTON_OBS_PROCESS
+    #: would make BOTH workers claim the same identity (flapping
+    #: heartbeats, a one-process skew join that vacuously passes the
+    #: band), an exported HTTP port would double-bind, and threshold
+    #: exports would silently change what the band measures
+    _FLEET_PINNED = (
+        "PHOTON_FAULTS", "PHOTON_OBS_PROCESS", "PHOTON_OBS_FLEET",
+        "PHOTON_OBS_HTTP_PORT", "PHOTON_FLEET_STRAGGLER_X",
+        "PHOTON_FLEET_STALE_X",
+    )
+    for pid in range(2):
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k != "XLA_FLAGS" and k not in _FLEET_PINNED
+        }
+        env["PHOTON_SANITIZE"] = "transfers"
+        env["PHOTON_OBS_HEARTBEAT_S"] = "0.5"
+        # worker output goes to FILES, never pipes: the two workers are
+        # collectively coupled, and a chatty peer blocked on a full
+        # 64 KiB pipe buffer stops entering collectives and deadlocks
+        # the whole leg (the exact lesson scripts/live_probe.py records)
+        log_path = os.path.join(tmpdir, f"fleet_p{pid}.log")
+        log_paths.append(log_path)
+        with open(log_path, "w") as log_f:
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, worker,
+                        "--devices", "2",
+                        "--num-processes", "2",
+                        "--process-id", str(pid),
+                        "--coordinator-port", str(coord_port),
+                        "--out", os.path.join(tmpdir, f"fleet_p{pid}.json"),
+                        "--out-root", out_root,
+                        "--n", str(n),
+                        "--users", str(users),
+                    ],
+                    stdout=log_f, stderr=subprocess.STDOUT, env=env,
+                )
+            )
+
+    def _tail(pid):
+        try:
+            with open(log_paths[pid]) as f:
+                return f.read()[-1200:]
+        except OSError:
+            return "(no log)"
+
+    try:
+        deadline = time.monotonic() + 900
+        for p in procs:
+            p.wait(timeout=max(1.0, deadline - time.monotonic()))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        return {"error": "fleet leg timed out after 900s"}
+    for pid, p in enumerate(procs):
+        if p.returncode != 0:
+            return {
+                "error": (
+                    f"fleet worker {pid} failed rc={p.returncode}:\n"
+                    f"{_tail(pid)}"
+                )
+            }
+    with open(os.path.join(tmpdir, "fleet_p0.json")) as f:
+        p0 = json.load(f)
+    skew = p0.get("sweep_skew") or []
+    bd = p0.get("device_breakdown") or {}
+    return {
+        "processes": 2,
+        "devices_per_process": 2,
+        "mesh_shape": p0.get("mesh_shape"),
+        "sweeps_joined": len(skew),
+        "max_skew_ratio": p0.get("max_skew_ratio"),
+        "stragglers": p0.get("stragglers") or [],
+        "steady_compiles": p0.get("steady_compiles"),
+        "audit_findings": p0.get("audit_findings"),
+        # the comm-vs-compute economics of the meshed sweep (the
+        # scaling-limit metric, PAPERS.md): measured barrier fraction +
+        # cost-model compute/collective split from the fit's own census
+        "device_barrier_frac": bd.get("barrier_frac"),
+        "device_compute_frac": bd.get("compute_frac"),
+        "device_comm_frac": bd.get("comm_frac"),
+        "sanitize": "transfers",
+    }
+
+
 def _mesh_scaling_ab(scale):
     """Meshed 1-vs-8 virtual-device GAME fit A/B (ROADMAP 1): two
     ``scripts/mesh_fit_worker.py`` subprocesses run the SAME deterministic
@@ -1347,7 +1493,9 @@ def _mesh_scaling_ab(scale):
         s8 = legs[8]["steady_sweep_s"]
         b1 = legs[1]["entity_table_bytes_per_device"]
         b8 = legs[8]["entity_table_bytes_per_device"]
+        fleet = _mesh_fleet_leg(worker, d, n, users)
         return {
+            "fleet": fleet,
             "rows": n,
             "users": users,
             "devices": [1, 8],

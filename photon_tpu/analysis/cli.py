@@ -215,10 +215,33 @@ def build_scorer_fixture(coordinates: dict[str, Any]) -> Any:
     return scorer
 
 
-def _mem_cell(footprint: dict[str, Any], key: str) -> str:
-    if not footprint or key not in footprint:
-        return "-"
-    return str(footprint[key])
+def breakdown_rows(reports: list[Any]) -> list[dict[str, Any]]:
+    """The per-executable comm/compute breakdown join, as data: each
+    audited program's XLA flop estimate, MemoryLedger footprint, and
+    priced communication census in one row — what the table prints and
+    what ``--breakdown-jsonl`` uploads next to the census artifact (the
+    offline comm-vs-compute economics record per program)."""
+    from photon_tpu.obs import memory as obs_memory
+
+    footprints = obs_memory.executable_footprints()
+    out = []
+    for report in reports:
+        for row in report.comm:
+            fp = footprints.get(row["ledger_label"]) or {}
+            sites = row["collective_sites"]
+            out.append(
+                {
+                    "program": row["program"],
+                    "kind": row.get("kind"),
+                    "flops": row["flops"],
+                    "argument_bytes": fp.get("argument_bytes"),
+                    "temp_bytes": fp.get("temp_bytes"),
+                    "collective_sites": len(sites),
+                    "comm_bytes": row["comm_bytes"],
+                    "ops": sorted({s["op"] for s in sites}),
+                }
+            )
+    return out
 
 
 def print_program_table(reports: list[Any]) -> None:
@@ -226,26 +249,19 @@ def print_program_table(reports: list[Any]) -> None:
     XLA's flop estimate, the PR 7 MemoryLedger footprint (argument/temp
     bytes from ``compiled.memory_analysis()``), and the communication
     census (collective sites + priced payload bytes)."""
-    from photon_tpu.obs import memory as obs_memory
-
-    footprints = obs_memory.executable_footprints()
     rows = []
-    for report in reports:
-        for row in report.comm:
-            fp = footprints.get(row["ledger_label"]) or {}
-            sites = row["collective_sites"]
-            ops = sorted({s["op"] for s in sites})
-            rows.append(
-                (
-                    row["program"],
-                    "-" if row["flops"] is None else f"{row['flops']:.3g}",
-                    _mem_cell(fp, "argument_bytes"),
-                    _mem_cell(fp, "temp_bytes"),
-                    str(len(sites)),
-                    str(row["comm_bytes"]),
-                    ",".join(ops) if ops else "-",
-                )
+    for r in breakdown_rows(reports):
+        rows.append(
+            (
+                r["program"],
+                "-" if r["flops"] is None else f"{r['flops']:.3g}",
+                "-" if r["argument_bytes"] is None else str(r["argument_bytes"]),
+                "-" if r["temp_bytes"] is None else str(r["temp_bytes"]),
+                str(r["collective_sites"]),
+                str(r["comm_bytes"]),
+                ",".join(r["ops"]) if r["ops"] else "-",
             )
+        )
     header = (
         "program", "flops", "arg_bytes", "temp_bytes",
         "coll_sites", "comm_bytes", "ops",
@@ -262,7 +278,10 @@ def print_program_table(reports: list[Any]) -> None:
         print("  " + fmt.format(*r))
 
 
-def run_program_checks(jsonl_rows: list[dict[str, Any]]) -> int:
+def run_program_checks(
+    jsonl_rows: list[dict[str, Any]],
+    breakdown_out: list[dict[str, Any]] | None = None,
+) -> int:
     from photon_tpu.analysis.hlo import audit_coordinates, audit_scorer
     from photon_tpu.game.data import re_shape_budget
 
@@ -324,6 +343,8 @@ def run_program_checks(jsonl_rows: list[dict[str, Any]]) -> int:
         f"{'none' if mesh is None else 'x'.join(map(str, mesh.devices.shape))}"
     )
     print_program_table(reports)
+    if breakdown_out is not None:
+        breakdown_out.extend(breakdown_rows(reports))
     for s in skipped:
         print(
             f"  WARNING: {s['program']} skipped — module text unreadable "
@@ -393,6 +414,12 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument(
         "--jsonl", type=Path, default=None,
         help="write every finding as JSONL to this path",
+    )
+    parser.add_argument(
+        "--breakdown-jsonl", type=Path, default=None,
+        help="with --programs: also write the per-executable "
+        "comm/compute breakdown (flops, memory footprint, collective "
+        "sites + priced bytes) as one JSONL row per program",
     )
     parser.add_argument(
         "--rules", default=None,
@@ -512,8 +539,18 @@ def main(argv: Sequence[str] | None = None) -> int:
         rc = 2
 
     if args.programs:
-        prc = run_program_checks(jsonl_rows)
+        bd_rows: list[dict[str, Any]] = []
+        prc = run_program_checks(jsonl_rows, breakdown_out=bd_rows)
         rc = rc or prc
+        if args.breakdown_jsonl:
+            args.breakdown_jsonl.parent.mkdir(parents=True, exist_ok=True)
+            with open(args.breakdown_jsonl, "w", encoding="utf-8") as fh:
+                for row in bd_rows:
+                    fh.write(json.dumps(row) + "\n")
+            print(
+                f"[photon-lint] wrote {len(bd_rows)} per-executable "
+                f"breakdown rows to {args.breakdown_jsonl}"
+            )
 
     if args.jsonl:
         args.jsonl.parent.mkdir(parents=True, exist_ok=True)

@@ -186,7 +186,12 @@ def run_profile(out_root=None):
     plane = None
     try:
         if out_root is not None:
-            plane = obs.live_plane(os.path.join(str(out_root), "obs"))
+            # fleet-aware namespacing (photon_tpu/obs/fleet.py):
+            # <out_root>/obs for a single process (historical layout,
+            # unchanged), <out_root>/obs/p<k> for process k of a
+            # jax.distributed run — N workers sharing one output root
+            # no longer clobber each other's ring/series/artifacts
+            plane = obs.live_plane(obs.fleet.obs_dir(out_root))
         try:
             yield
         except BaseException as e:
@@ -214,7 +219,7 @@ def _export_failure_artifacts(out_root, exc: BaseException) -> None:
         pass
     try:
         obs.export_partial_artifacts(
-            os.path.join(str(out_root), "obs"),
+            obs.fleet.obs_dir(out_root),
             meta={"failed": True, "error": reason},
         )
     except Exception:  # pragma: no cover - exporter already guards
@@ -236,9 +241,50 @@ def export_run_profile(out_root, log=None, meta=None) -> dict | None:
     if not obs.enabled():
         return None
     paths = obs.export_artifacts(
-        os.path.join(str(out_root), "obs"), meta=meta
+        obs.fleet.obs_dir(out_root), meta=meta
     )
     if log is not None:
         log.info("run profile:\n%s", obs.summary_table())
         log.info("telemetry artifacts: %s", paths)
+    fleet_path = export_fleet_report(log)
+    if fleet_path is not None:
+        paths["fleet_report"] = fleet_path
     return paths
+
+
+def export_fleet_report(log=None) -> str | None:
+    """Process 0 of a fleet run writes the offline fleet document
+    (worker heartbeat table, merged registry, per-sweep skew rows,
+    stragglers — photon_tpu/obs/fleet.py) as ``fleet_report.json`` at
+    the shared obs root. No-op (None) single-process, on workers k>0,
+    or when no publisher is armed; guarded — the report must never fail
+    the run it describes."""
+    import json
+
+    from photon_tpu import obs
+
+    pub = obs.fleet.get_publisher()
+    if pub is None or pub.info.index != 0:
+        return None
+    try:
+        doc = obs.fleet.fleet_report(pub.fleet_root)
+        path = os.path.join(pub.fleet_root, "fleet_report.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, default=str, sort_keys=True)
+    except Exception as e:  # pragma: no cover - defensive
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "fleet report export failed: %s: %s", type(e).__name__, e
+        )
+        return None
+    if log is not None:
+        workers = doc.get("workers", [])
+        bad = [w for w in workers if w.get("status") != "ok"]
+        log.info(
+            "fleet report: %d workers (%d not ok), %d skew rows, "
+            "%d straggler flags -> %s",
+            len(workers), len(bad), len(doc.get("skew", [])),
+            len(doc.get("stragglers", [])), path,
+        )
+    return path

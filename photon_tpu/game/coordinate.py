@@ -52,6 +52,14 @@ from photon_tpu.optimize.problem import GLMProblem, GLMProblemConfig
 from photon_tpu.types import Array, LabeledBatch, SparseBatch
 from photon_tpu.util import dispatch_count
 
+
+def _fetch_global(x) -> np.ndarray:
+    """Host copy of a possibly process-spanning array (model-export
+    boundary; see ``parallel.distributed.fetch_global``)."""
+    from photon_tpu.parallel.distributed import fetch_global
+
+    return fetch_global(x)
+
 logger = logging.getLogger(__name__)
 
 #: Per-program TRACE counters: the Python bodies below bump these, and
@@ -1239,7 +1247,10 @@ class RandomEffectCoordinate(Coordinate):
                     batch = LabeledBatch(features=f, labels=l, offsets=o, weights=w)
                     return problem.variances(batch, w_opt)
 
-                variances = np.asarray(
+                # same export-boundary rule as the coefficients below:
+                # under jax.distributed the vmapped result is
+                # entity-sharded across processes and must all-gather
+                variances = _fetch_global(
                     jax.vmap(var_one)(
                         db.features, db.labels, db.offsets, db.train_weights, coefs
                     )
@@ -1252,8 +1263,11 @@ class RandomEffectCoordinate(Coordinate):
                     # snapshot, not view: np.asarray of the solve output
                     # on XLA:CPU aliases the device buffer, and the state
                     # is donated to the next fused sweep — an exported
-                    # model would silently track the live buffers
-                    coefficients=np.asarray(coefs)[:e_real].copy(),
+                    # model would silently track the live buffers.
+                    # fetch_global: under jax.distributed the entity
+                    # axis spans non-addressable devices and the export
+                    # must all-gather (parallel/distributed.py)
+                    coefficients=_fetch_global(coefs)[:e_real].copy(),
                     variances=None if variances is None else variances[:e_real],
                 )
             )

@@ -582,6 +582,13 @@ def run_coordinate_descent(
             dispatches=dispatches,
             health=health,
         )
+        # fleet tap (obs/fleet.py): this process's barrier-ARRIVAL wall
+        # for the sweep — the per-worker skew signal the aggregator
+        # joins by iteration. Host file append only; two module-global
+        # reads when no fleet publisher is armed (single-process runs)
+        obs.fleet.record_sweep(
+            it, sweep_span.duration_s, barrier_s
+        )
         diverged = [
             cid for cid, h in health.items() if not h["finite"]
         ]

@@ -776,6 +776,20 @@ class GameEstimator:
             if checkpointer is not None:
                 checkpointer.mark_grid_done(gi, states, fingerprint)
 
+        # per-sweep device-time breakdown (obs/fleet.py): join this
+        # fit's OWN sweep executables (SPMD comm census + XLA cost
+        # flops) with the measured sweep/barrier walls of the LAST
+        # trained grid point — published as device.* gauges and the
+        # breakdown artifact. Host-side pricing only, after training;
+        # guarded so attribution can never fail a fit. Resumed grids
+        # hold None placeholders for points completed in a previous
+        # life — price the last one THIS call actually swept.
+        done = [r for r in results if r is not None]
+        if done:
+            obs.fleet.publish_device_breakdown(
+                coordinates, done[-1].tracker
+            )
+
         return results
 
     # ------------------------------------------------------------------

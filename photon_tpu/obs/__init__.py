@@ -50,7 +50,7 @@ from __future__ import annotations
 import logging
 import os
 
-from photon_tpu.obs import flight, health, http, memory, series
+from photon_tpu.obs import fleet, flight, health, http, memory, series
 from photon_tpu.obs.export import (
     chrome_trace,
     export_artifacts,
@@ -78,6 +78,7 @@ __all__ = [
     "enabled",
     "export_artifacts",
     "export_partial_artifacts",
+    "fleet",
     "flight",
     "gauge",
     "get_registry",
@@ -138,6 +139,8 @@ def reset() -> None:
     _tracer.clear()
     _registry.clear()
     memory.get_ledger().reset_run_state()
+    fleet.clear_breakdown()
+    fleet.clear_sweeps_cache()
 
 
 def span(name: str, cat: str = "phase", **args) -> Span:
@@ -188,6 +191,7 @@ class LiveTelemetryPlane:
         self.recorder = None
         self.flusher = None
         self.server = None
+        self.fleet_publisher = None
 
     def start(self) -> "LiveTelemetryPlane":
         """Arm the plane. Exception-safe: if any later step fails (an
@@ -205,6 +209,10 @@ class LiveTelemetryPlane:
             self.flusher = series.start_flusher(
                 os.path.join(self.directory, "series.jsonl")
             )
+            # fleet membership (photon_tpu/obs/fleet.py): heartbeat
+            # snapshots + the per-sweep arrival log; a no-op (None) in a
+            # single-process run unless PHOTON_OBS_FLEET=1 forces it
+            self.fleet_publisher = fleet.start_publisher(self.directory)
             self.server = http.start_from_env()
         except BaseException:
             self.close()
@@ -214,6 +222,7 @@ class LiveTelemetryPlane:
     def close(self) -> None:
         for step in (
             http.stop_server,
+            fleet.stop_publisher,
             series.stop_flusher,
             flight.uninstall_crash_handler,
             flight.disable,

@@ -180,12 +180,27 @@ def export_artifacts(
         ),
         "memory": write_memory_report(_path("memory_report.json"), meta),
     }
+    # the per-sweep device-time breakdown (obs/fleet.py: census bytes +
+    # cost-model flops joined with measured walls) — written only when a
+    # fit published one, so non-fit runs keep the historical layout
+    from photon_tpu.obs import fleet as obs_fleet
+
+    bd = obs_fleet.get_breakdown()
+    if bd is not None:
+        bd_path = _path(obs_fleet.BREAKDOWN_FILENAME)
+        with open(bd_path, "w") as f:
+            json.dump(_json_safe({**(meta or {}), "breakdown": bd}), f,
+                      indent=2, sort_keys=True)
+        paths["breakdown"] = bd_path
     summary_path = _path("summary.txt")
     with open(summary_path, "w") as f:
         f.write(summary_table(tracer) + "\n")
         hist_block = histogram_summary(registry)
         if hist_block:
             f.write("\n" + hist_block + "\n")
+        bd_block = obs_fleet.breakdown_table(bd)
+        if bd_block:
+            f.write("\n" + bd_block + "\n")
     paths["summary"] = summary_path
     return paths
 

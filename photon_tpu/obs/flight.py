@@ -390,7 +390,36 @@ def recover_stale(directory: str) -> str | None:
     it was doing into ``blackbox-<seq>.json`` and return the path.
     Returns None when there is no ring or the previous run closed
     cleanly. Call BEFORE :func:`enable` truncates the ring for this
-    run."""
+    run.
+
+    Fleet layout: a multi-process run namespaces rings under
+    ``<obs>/p<k>/`` (photon_tpu/obs/fleet.py), so a relaunch arming the
+    plane at ``<obs>`` (single-process, after a fleet run died) also
+    scans one level of ``p*/`` children and recovers every dead
+    worker's ring — each into ITS OWN directory. The primary (own-dir)
+    recovery path is returned; child recoveries are logged."""
+    first_child: str | None = None
+    try:
+        with os.scandir(directory) as it:
+            children = sorted(
+                e.path
+                for e in it
+                if e.is_dir()
+                and e.name.startswith("p")
+                and e.name[1:].isdigit()
+            )
+    except OSError:
+        children = []
+    for child in children:
+        if os.path.exists(os.path.join(child, RING_FILENAME)):
+            out = recover_stale(child)
+            if out is not None and first_child is None:
+                first_child = out
+    own = _recover_one(directory)
+    return own if own is not None else first_child
+
+
+def _recover_one(directory: str) -> str | None:
     path = os.path.join(directory, RING_FILENAME)
     if not os.path.exists(path):
         return None

@@ -13,10 +13,16 @@ run's blackbox holds its last metric deltas, not nothing.
 
 Row schema (one JSON object per line)::
 
-    {"kind": "series", "row": <n>, "t_s": <monotonic offset>,
-     "wall_s": <epoch + t_s>, "interval_s": <measured>,
+    {"kind": "series", "row": <n>, "process_index": <k>, "host": <name>,
+     "t_s": <monotonic offset>, "wall_s": <epoch + t_s>,
+     "heartbeat_wall_s": <fresh wall stamp>, "interval_s": <measured>,
      "counters": {<name>: <delta>}, "gauges": {<name>: <value>},
      "histograms": {<name>: {"count": <delta>, "p50":..,"p90":..,"p99":..}}}
+
+``process_index``/``host`` make rows from N fleet workers' files
+attributable after concatenation, and ``heartbeat_wall_s`` is a FRESH
+wall read per flush (``wall_s`` steps from the start epoch) — the
+liveness stamp a fleet reader ages against its own clock.
 
 ``scripts/bench_trend.py --series`` reads this file to plot/gate
 WITHIN-run throughput decay. Flush cadence policy: the default 10 s
@@ -66,10 +72,15 @@ class SeriesFlusher:
 
     def __init__(self, path: str, interval_s: float, registry=None):
         from photon_tpu import obs
+        from photon_tpu.obs import fleet
 
         self.path = str(path)
         self.interval_s = float(interval_s)
         self._registry = registry or obs.get_registry()
+        #: fleet identity stamped into every row (process 0 of 1 in a
+        #: single-process run) so rows from N workers' files remain
+        #: attributable after any downstream concatenation
+        self._proc = fleet.process_info()
         self._obs = obs
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -103,8 +114,15 @@ class SeriesFlusher:
             row = {
                 "kind": "series",
                 "row": self.rows_written,
+                "process_index": self._proc.index,
+                "host": self._proc.host,
                 "t_s": round(now - self._epoch, 6),
                 "wall_s": round(self._epoch_wall_s + (now - self._epoch), 3),
+                # a FRESH wall stamp per flush (wall_s above steps from
+                # the start epoch): the fleet-liveness signal a reader
+                # can age against its own clock
+                # phl-ok: PHL006 heartbeat stamps are wall-clock by definition (cross-process aging)
+                "heartbeat_wall_s": round(time.time(), 3),
                 "interval_s": round(interval, 6),
                 "counters": {
                     k: v

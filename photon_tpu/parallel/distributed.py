@@ -56,6 +56,29 @@ def initialize(
     )
 
 
+def fetch_global(x) -> np.ndarray:
+    """Host numpy copy of a possibly process-SPANNING ``jax.Array``.
+
+    ``np.asarray`` on an array whose shards live on another process's
+    devices raises (``Fetching value … that spans non-addressable
+    devices is not possible``) — exactly what a multi-process meshed
+    fit's model EXPORT hits on the entity-sharded RE coefficients, the
+    one place training must materialize global bytes on every host.
+    This routes that case through ``multihost_utils.process_allgather``
+    (a collective — every process must call it, which SPMD discipline
+    already guarantees for ``to_model``); fully-addressable arrays take
+    the plain copy path. Export/checkpoint boundary only — never the
+    steady state (the sanitizer lanes would catch it there)."""
+    if isinstance(x, jax.Array) and not getattr(
+        x, "is_fully_addressable", True
+    ):
+        from jax.experimental import multihost_utils
+
+        # phl-ok: PHL002 export-boundary gather — the documented global materialization point
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def global_data_mesh(axis: str = "data") -> Mesh:
     """One data axis over every device of every process."""
     devs = np.array(jax.devices())

@@ -174,10 +174,13 @@ def judge_fresh(
     fresh_rows: dict[str, dict],
     series: dict[str, list[dict]],
     tolerance: float,
+    skew_tolerance: float | None = None,
 ) -> list[dict]:
     """Verdict rows for every fresh config: quality bands (the SAME
     tolerances the bench orchestrator enforces) + trend vs the latest
-    comparable historical row."""
+    comparable historical row + the fleet skew gate
+    (``--skew-tolerance``: a mesh A/B fleet leg whose per-sweep max
+    skew ratio exceeds it is a straggler regression, exit 3)."""
     from bench import check_quality_bands
 
     verdicts = []
@@ -187,6 +190,18 @@ def judge_fresh(
         if violations:
             v["status"] = "fail"
             v["notes"].extend(f"quality band: {x}" for x in violations)
+        fleet = (row["detail"].get("mesh") or {}).get("fleet") or {}
+        sk = fleet.get("max_skew_ratio")
+        if sk is not None:
+            v["fleet_max_skew_ratio"] = sk
+            v["fleet_stragglers"] = fleet.get("stragglers") or []
+            if skew_tolerance is not None and sk > skew_tolerance:
+                v["status"] = "fail"
+                v["notes"].append(
+                    f"fleet per-sweep skew ratio {sk} > "
+                    f"--skew-tolerance {skew_tolerance} (straggler "
+                    "regression)"
+                )
         prior = [
             r
             for r in series.get(name, [])
@@ -447,6 +462,15 @@ def main(argv=None) -> int:
         help="gate within-run decay: fail when the last interval's rate "
         "drops below R x the run's peak rate (unset: report only)",
     )
+    ap.add_argument(
+        "--skew-tolerance",
+        type=float,
+        default=None,
+        metavar="X",
+        help="gate the mesh fleet leg's per-sweep skew: fail (exit 3) "
+        "when a fresh run's max start-lateness skew ratio exceeds X — "
+        "a straggler regression (unset: the quality band alone gates)",
+    )
     args = ap.parse_args(argv)
 
     paths = sorted(glob.glob(args.history))
@@ -472,7 +496,9 @@ def main(argv=None) -> int:
         for name, row in config_rows(fresh_entry).items():
             row["round"] = fresh_entry["round"]
             fresh_rows[name] = row
-        verdicts = judge_fresh(fresh_rows, series, args.tolerance)
+        verdicts = judge_fresh(
+            fresh_rows, series, args.tolerance, args.skew_tolerance
+        )
         for name, row in fresh_rows.items():
             series.setdefault(name, []).append(row)
 
@@ -485,7 +511,12 @@ def main(argv=None) -> int:
         notes = "; ".join(v["notes"]) if v["notes"] else ""
         vs = v.get("vs")
         trend = f" {vs['ratio']}x vs {vs['round']}" if vs else ""
-        print(f"[{marker}] {v['config']}{trend} {notes}".rstrip())
+        skew = (
+            f" fleet-skew {v['fleet_max_skew_ratio']}x"
+            if "fleet_max_skew_ratio" in v
+            else ""
+        )
+        print(f"[{marker}] {v['config']}{trend}{skew} {notes}".rstrip())
 
     series_verdicts: list[dict] = []
     if args.series:
@@ -538,6 +569,7 @@ def main(argv=None) -> int:
             "tolerance": args.tolerance,
             "within_run": series_verdicts,
             "series_tolerance": args.series_tolerance,
+            "skew_tolerance": args.skew_tolerance,
             "northstar": northstar_rows,
             "northstar_notes": northstar_notes,
         }

@@ -174,6 +174,40 @@ def _canonical_cache_leg() -> None:
         shutil.rmtree(data_dir, ignore_errors=True)
 
 
+def _canonical_fleet_leg(flight_dir: str) -> None:
+    """Deterministic fleet-plane exercise (see the call site): no
+    threads, no subprocesses, fixed synthetic walls — the counters it
+    emits cannot depend on machine speed."""
+    import json as _json
+
+    from photon_tpu.obs import fleet
+
+    root = os.path.join(flight_dir, "fleet")
+    info = fleet.ProcessInfo(index=0, count=2, host="gate", pid=os.getpid())
+    pub = fleet.FleetPublisher(
+        os.path.join(root, "p0"), interval_s=60.0, info=info
+    )
+    pub.write_heartbeat()  # fleet.heartbeats = 1
+    pub.record_sweep(0, 0.5, 0.1)  # fleet.sweep_rows = 2
+    pub.record_sweep(1, 0.5, 0.1)
+    # a synthetic peer whose sweep 1 started 8 unobstructed sweeps late:
+    # exactly one straggler flag (fleet.stragglers = 1 + the lifecycle
+    # instant), deduplicated on the second aggregation pass
+    os.makedirs(os.path.join(root, "p1"), exist_ok=True)
+    own = fleet.read_sweeps(root)[0]
+    with open(os.path.join(root, "p1", fleet.SWEEPS_FILENAME), "w") as f:
+        for row in own:
+            peer = dict(
+                row,
+                process_index=1,
+                start_wall_s=row["start_wall_s"] + 4.0 * row["iteration"],
+                arrival_wall_s=row["arrival_wall_s"] + 4.0 * row["iteration"],
+            )
+            f.write(_json.dumps(peer) + "\n")
+    pub.aggregate_once()
+    pub.aggregate_once()  # dedup: must not re-fire the event
+
+
 def collect_snapshot() -> dict:
     """Run the canonical fit (and a canonical streaming score of the
     fitted model — the ``score.*`` taxonomy) under a clean telemetry
@@ -216,6 +250,16 @@ def collect_snapshot() -> dict:
             # the cache leg pins the python decoder explicitly; an
             # ambient export must not double the io.decode census
             "PHOTON_NO_NATIVE_AVRO",
+            # fleet-plane knobs: a forced PHOTON_OBS_FLEET=1 or an
+            # exported process identity would arm heartbeats/sweep logs
+            # (fleet.* counters) in the single-process canonical fit
+            "PHOTON_OBS_FLEET",
+            "PHOTON_OBS_PROCESS",
+            "PHOTON_OBS_HEARTBEAT_S",
+            "PHOTON_FLEET_STRAGGLER_X",
+            "PHOTON_FLEET_STALE_X",
+            "PHOTON_COMM_GBPS",
+            "PHOTON_DEVICE_GFLOPS",
         )
     }
     flight_dir = None
@@ -269,6 +313,13 @@ def collect_snapshot() -> dict:
         # is pinned to the python codec so the io.decode census cannot
         # depend on whether the native .so loaded on this machine.
         _canonical_cache_leg()
+        # canonical fleet leg: a deterministic two-process fleet shape
+        # without threads or subprocesses — one heartbeat snapshot, two
+        # per-sweep arrival rows, a synthetic 8s-late peer row, one
+        # aggregation pass. Pins the fleet.* taxonomy (heartbeats /
+        # sweep_rows / stragglers counters + the straggler lifecycle
+        # instant) into the gated shape.
+        _canonical_fleet_leg(flight_dir)
         SeriesFlusher(
             os.path.join(flight_dir, "series.jsonl"), 60.0
         ).flush_once()

@@ -16,13 +16,29 @@ GAME training driver as a subprocess with the HTTP endpoints armed
 
 Exit 0 = all probes green; non-zero with a named failure otherwise.
 
-Usage: python scripts/live_probe.py [--workdir DIR] [--n 400]
+Fleet mode (``--fleet``, ISSUE 14): instead of the single driver, launch
+a REAL 2-process Gloo ``jax.distributed`` meshed fit
+(``scripts/mesh_fit_worker.py``) with the fleet plane armed, a stall
+fault injected into worker 1's sweep loop, and probe:
+
+1. process 0's ``/metrics`` MID-RUN: the vendored parser must see the
+   per-process families (``photon_proc_*{process=}``) AND the aggregate
+   ``photon_fleet_*`` families, with the fleet counter equal to the sum
+   of its per-process samples — ONE aggregated scrape;
+2. ``/healthz`` flags the stalled worker as a straggler (arrival-skew
+   attribution) — and, after a SIGSTOP, as stale-by-heartbeat within
+   the configured staleness window, then recovers after SIGCONT;
+3. both workers exit 0 and ``scripts/fleet_report.py`` yields per-sweep
+   arrival-skew rows over the shared obs root.
+
+Usage: python scripts/live_probe.py [--workdir DIR] [--n 400] [--fleet]
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -49,6 +65,255 @@ def get(url: str, timeout: float = 5.0) -> bytes:
         return resp.read()
 
 
+def probe_fleet(args) -> int:
+    """The 2-process Gloo fleet lane (see module docstring)."""
+    from photon_tpu.obs.http import parse_prometheus_text
+
+    work = args.workdir or tempfile.mkdtemp(prefix="photon-fleet-probe-")
+    os.makedirs(work, exist_ok=True)
+    out_root = os.path.join(work, "fleet")
+    port = free_port()
+    coord_port = free_port()
+    worker = os.path.join(REPO, "scripts", "mesh_fit_worker.py")
+
+    heartbeat_s = 0.5
+    procs, logs = [], []
+    for pid in range(2):
+        # ambient fleet knobs pinned out: an exported PHOTON_OBS_PROCESS
+        # would make both workers claim one identity, an ambient HTTP
+        # port would double-bind (worker 1 must serve NO endpoints)
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k
+            not in (
+                "XLA_FLAGS", "JAX_PLATFORMS", "PHOTON_FAULTS",
+                "PHOTON_OBS_PROCESS", "PHOTON_OBS_FLEET",
+                "PHOTON_OBS_HTTP_PORT", "PHOTON_FLEET_STRAGGLER_X",
+                "PHOTON_FLEET_STALE_X",
+            )
+        }
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["PHOTON_OBS_FLUSH_S"] = "1"
+        env["PHOTON_OBS_HEARTBEAT_S"] = str(heartbeat_s)
+        if pid == 0:
+            # the aggregated endpoints live on process 0 only
+            env["PHOTON_OBS_HTTP_PORT"] = str(port)
+        else:
+            # the straggler: a 6 s stall at the top of sweep 2 delays
+            # THIS worker's sweep start while process 0 waits in the
+            # collective — the skew signature the aggregator must
+            # attribute to worker 1; the second stall holds the fit
+            # open so the SIGSTOP staleness leg has a live window
+            env["PHOTON_FAULTS"] = (
+                "descent.sweep@2=stall:6;descent.sweep@5=stall:10"
+            )
+        log_path = os.path.join(work, f"worker{pid}.out")
+        logs.append(log_path)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, worker,
+                    "--devices", "2",
+                    "--num-processes", "2",
+                    "--process-id", str(pid),
+                    "--coordinator-port", str(coord_port),
+                    "--out", os.path.join(work, f"leg_p{pid}.json"),
+                    "--out-root", out_root,
+                    "--n", str(max(args.n, 256)),
+                    "--users", "64",
+                    "--iters", "6",
+                ],
+                cwd=REPO, env=env,
+                stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+            )
+        )
+
+    def dump_logs_and_die(msg: str):
+        for i, lp in enumerate(logs):
+            try:
+                print(f"--- worker {i} log tail ---")
+                print(open(lp).read()[-3000:])
+            except OSError:
+                pass
+        raise SystemExit(msg)
+
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + args.deadline
+    try:
+        # -- probe 1: ONE aggregated /metrics scrape mid-run ----------
+        families = None
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                dump_logs_and_die(
+                    "[fleet-probe] a worker exited before aggregation "
+                    "was observable"
+                )
+            try:
+                body = get(base + "/metrics").decode()
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.25)
+                continue
+            fams = parse_prometheus_text(body)  # raises on malformed text
+            has_proc = any(n.startswith("photon_proc_") for n in fams)
+            has_fleet = any(n.startswith("photon_fleet_") for n in fams)
+            # both workers present in the per-process families?
+            seen_procs = {
+                lbl.get("process")
+                for fam in fams.values()
+                for (_n, lbl, _v) in fam["samples"]
+                if "process" in lbl
+            }
+            if has_proc and has_fleet and seen_procs >= {"0", "1"}:
+                families = fams
+                break
+            time.sleep(0.25)
+        if families is None:
+            dump_logs_and_die(
+                "[fleet-probe] aggregated /metrics (proc + fleet "
+                "families from both workers) never appeared"
+            )
+        # fleet counter == sum of its per-process samples (pick a
+        # family that both workers bump: sweep count)
+        fname = "photon_fleet_descent_sweeps_total"
+        pname = "photon_proc_descent_sweeps_total"
+        if fname in families and pname in families:
+            fleet_v = families[fname]["samples"][0][2]
+            proc_sum = sum(v for _n, _l, v in families[pname]["samples"])
+            if abs(fleet_v - proc_sum) > 1e-9:
+                raise SystemExit(
+                    f"[fleet-probe] fleet counter {fleet_v} != per-process "
+                    f"sum {proc_sum}"
+                )
+        print(
+            f"[fleet-probe] /metrics ok: {len(families)} families incl. "
+            "per-process + fleet aggregates (fleet = Σ per-process)"
+        )
+
+        # -- probe 2: straggler attribution in /healthz ---------------
+        straggled = False
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break  # fit finished first — the offline report (probe
+                # 4) still must name the straggler
+            try:
+                hz = json.loads(get(base + "/healthz"))
+            except (urllib.error.URLError, ConnectionError, OSError):
+                # server closed between the liveness poll and the GET
+                # (the fit ended) — defer to the offline report
+                break
+            fleet_doc = hz.get("fleet") or {}
+            if 1 in (fleet_doc.get("stragglers") or []):
+                straggled = True
+                print(
+                    "[fleet-probe] /healthz flagged worker 1 as the "
+                    f"straggler (max skew ratio "
+                    f"{fleet_doc.get('max_skew_ratio')})"
+                )
+                break
+            time.sleep(0.25)
+        if not straggled:
+            if any(p.poll() is not None for p in procs):
+                print(
+                    "[fleet-probe] fit finished before a live straggler "
+                    "scrape; deferring to the offline report check"
+                )
+            else:
+                dump_logs_and_die(
+                    "[fleet-probe] stalled worker was never flagged "
+                    "straggler"
+                )
+
+        # -- probe 3: SIGSTOP'd worker goes stale by heartbeat --------
+        if procs[1].poll() is None:
+            os.kill(procs[1].pid, signal.SIGSTOP)
+            stale_deadline = time.monotonic() + 3 * heartbeat_s + 5.0
+            went_stale = False
+            try:
+                while time.monotonic() < stale_deadline:
+                    try:
+                        hz = json.loads(get(base + "/healthz"))
+                    except (
+                        urllib.error.URLError, ConnectionError, OSError
+                    ):
+                        break  # endpoints gone — p0 finished its fit
+                    fleet_doc = hz.get("fleet") or {}
+                    bad = set(fleet_doc.get("stale") or []) | set(
+                        fleet_doc.get("dead") or []
+                    )
+                    if 1 in bad:
+                        went_stale = True
+                        print(
+                            "[fleet-probe] SIGSTOP'd worker 1 reported "
+                            f"{'dead' if 1 in (fleet_doc.get('dead') or []) else 'stale'}"
+                            " by heartbeat age"
+                        )
+                        break
+                    time.sleep(heartbeat_s / 2)
+            finally:
+                os.kill(procs[1].pid, signal.SIGCONT)
+            if not went_stale:
+                dump_logs_and_die(
+                    "[fleet-probe] SIGSTOP'd worker never went stale in "
+                    "/healthz"
+                )
+        else:
+            print(
+                "[fleet-probe] worker 1 already finished; skipping the "
+                "SIGSTOP staleness leg"
+            )
+
+        # -- workers must finish clean --------------------------------
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=max(10.0, deadline - time.monotonic()))
+            if rc != 0:
+                dump_logs_and_die(f"[fleet-probe] worker {i} failed rc={rc}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, signal.SIGCONT)
+                except OSError:
+                    pass
+                p.kill()
+                p.wait()
+
+    # -- probe 4: the offline fleet report ----------------------------
+    report_out = os.path.join(work, "fleet_report.json")
+    res = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "fleet_report.py"),
+            out_root, "--out", report_out,
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    print(res.stdout[-2500:])
+    if res.returncode != 0:
+        raise SystemExit(
+            f"[fleet-probe] fleet_report failed rc={res.returncode}:\n"
+            f"{res.stderr[-2000:]}"
+        )
+    with open(report_out) as f:
+        report = json.load(f)
+    if not report.get("skew"):
+        raise SystemExit("[fleet-probe] fleet report has no skew rows")
+    if 1 not in {s["process_index"] for s in report.get("stragglers", [])}:
+        raise SystemExit(
+            "[fleet-probe] fleet report did not name worker 1 a straggler"
+        )
+    if len(report.get("workers", [])) != 2:
+        raise SystemExit(
+            f"[fleet-probe] expected 2 worker heartbeats, got "
+            f"{report.get('workers')}"
+        )
+    print(
+        f"[fleet-probe] report ok: {len(report['skew'])} skew rows, "
+        f"stragglers={[s['process_index'] for s in report['stragglers']]}. "
+        "ALL FLEET PROBES GREEN"
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--workdir", default=None)
@@ -57,7 +322,15 @@ def main() -> int:
         "--deadline", type=float, default=300.0,
         help="seconds to wait for the endpoints, then the driver exit",
     )
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="run the 2-process Gloo fleet lane instead of the single "
+        "driver probe",
+    )
     args = ap.parse_args()
+
+    if args.fleet:
+        return probe_fleet(args)
 
     from photon_tpu.obs.http import parse_prometheus_text
 

@@ -21,10 +21,23 @@ the parity compare is tight) end-to-end on an ``1 × devices``
 * the trained coefficients (FE means + per-entity RE rows keyed by
   entity) as an npz next to ``--out`` for the cross-leg parity compare.
 
-Invoked by ``bench._mesh_scaling_ab`` and usable standalone:
+Fleet lane (ISSUE 14): with ``--num-processes N --process-id K
+--coordinator-port P`` the worker joins a ``jax.distributed`` job over
+Gloo — N OS processes × ``--devices`` virtual CPU devices each form ONE
+global mesh and the SAME fit runs SPMD across them (real cross-process
+collectives in the sweep). ``--out-root`` arms the full telemetry plane
+(photon_tpu/obs): per-process ``obs/p<k>/`` artifacts, fleet heartbeat
+snapshots, the per-sweep barrier-arrival log, and — on process 0 with
+``PHOTON_OBS_HTTP_PORT`` set — the aggregated ``/metrics`` +
+``/healthz`` endpoints. The out JSON then also carries the per-sweep
+arrival-skew rows and the device-time compute/comm/barrier breakdown.
+
+Invoked by ``bench._mesh_scaling_ab`` / ``scripts/live_probe.py
+--fleet`` and usable standalone:
     python scripts/mesh_fit_worker.py --devices 8 --out /tmp/leg8.json
 """
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -44,7 +57,26 @@ ap.add_argument(
     "--checkpoint-dir", default=None,
     help="optional: checkpoint every sweep (the meshed save path)",
 )
+ap.add_argument(
+    "--num-processes", type=int, default=1,
+    help="fleet lane: total processes of the jax.distributed job",
+)
+ap.add_argument(
+    "--process-id", type=int, default=0,
+    help="fleet lane: this process's id (0..num-processes-1)",
+)
+ap.add_argument(
+    "--coordinator-port", type=int, default=None,
+    help="fleet lane: jax.distributed coordinator port on 127.0.0.1",
+)
+ap.add_argument(
+    "--out-root", default=None,
+    help="arm the telemetry plane under <out-root>/obs (fleet-namespaced "
+    "per process) and export run artifacts there",
+)
 args = ap.parse_args()
+if args.num_processes > 1 and args.coordinator_port is None:
+    ap.error("--num-processes > 1 requires --coordinator-port")
 
 # platform pinned BEFORE any jax import side effect (conftest discipline)
 os.environ["XLA_FLAGS"] = (
@@ -61,6 +93,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+if args.num_processes > 1:
+    # join the multi-controller job BEFORE any backend touch: the global
+    # device set spans every process and collectives ride Gloo
+    from photon_tpu.parallel.distributed import initialize  # noqa: E402
+
+    initialize(
+        f"127.0.0.1:{args.coordinator_port}",
+        args.num_processes,
+        args.process_id,
+    )
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -128,6 +171,8 @@ def entity_table_bytes_per_device(coordinates) -> int:
 
 
 def main() -> None:
+    # identical global data on every process (deterministic seed): the
+    # fleet lane's multi-controller contract, same as test_multihost
     rng = np.random.default_rng(args.seed)
     data = build_data(rng, args.n, args.fe_dim, args.users, args.d_re)
     opt_re = GLMProblemConfig(
@@ -142,9 +187,10 @@ def main() -> None:
         ),
         regularization=RegularizationContext(RegularizationType.L2),
     )
+    total_devices = len(jax.devices())  # global: spans the whole fleet
     mesh = (
-        make_mesh(num_data=1, num_entity=args.devices)
-        if args.devices > 1
+        make_mesh(num_data=1, num_entity=total_devices)
+        if total_devices > 1
         else None
     )
     est = GameEstimator(
@@ -166,10 +212,42 @@ def main() -> None:
         precompile=True,
         keep_coordinates=True,  # audited + shard-measured post-fit
     )
-    t0 = time.perf_counter()
-    results = est.fit(data, mesh=mesh, checkpoint_dir=args.checkpoint_dir)
-    fit_wall = time.perf_counter() - t0
-    result = results[0]
+    # the telemetry session: obs spine + live plane (flight ring, series
+    # flusher, fleet heartbeats/sweep log, endpoints from env) under the
+    # fleet-namespaced per-process obs dir — or a no-op without out-root
+    if args.out_root:
+        from photon_tpu.cli.game_base import export_run_profile, run_profile
+
+        profile = run_profile(args.out_root)
+    else:
+        profile = contextlib.nullcontext()
+    with profile:
+        t0 = time.perf_counter()
+        results = est.fit(data, mesh=mesh, checkpoint_dir=args.checkpoint_dir)
+        fit_wall = time.perf_counter() - t0
+        result = results[0]
+
+        from photon_tpu import obs
+
+        breakdown = obs.fleet.get_breakdown()
+        fleet_extras = {}
+        if args.out_root:
+            export_run_profile(args.out_root)
+            fleet_root = obs.fleet.fleet_root_of(
+                obs.fleet.obs_dir(args.out_root)
+            )
+            skew = obs.fleet.compute_skew(obs.fleet.read_sweeps(fleet_root))
+            fleet_extras = {
+                "obs_root": fleet_root,
+                "sweep_skew": skew,
+                # warmup-excluded (obs/fleet.py max_skew_ratio): this is
+                # the band-gated number, and a gate reading the first
+                # sweep's legitimate startup skew would fail healthy runs
+                "max_skew_ratio": obs.fleet.max_skew_ratio(skew),
+                "stragglers": sorted(
+                    {p for r in skew for p in r["stragglers"]}
+                ),
+            }
 
     sweep_rows = [
         r for r in result.tracker
@@ -206,6 +284,9 @@ def main() -> None:
 
     out = {
         "devices": args.devices,
+        "num_processes": args.num_processes,
+        "process_id": args.process_id,
+        "total_devices": total_devices,
         "mesh_shape": (
             "x".join(str(s) for s in mesh.devices.shape) if mesh else "1"
         ),
@@ -222,10 +303,41 @@ def main() -> None:
         "sanitize": os.environ.get("PHOTON_SANITIZE", ""),
         "coeffs_npz": npz_path,
         "checkpointed": bool(args.checkpoint_dir),
+        # device-time attribution (obs/fleet.py): measured barrier
+        # fraction + cost-model compute/comm split of the steady sweep
+        "device_breakdown": (
+            None
+            if breakdown is None
+            else {
+                "barrier_frac": breakdown["barrier_frac"],
+                "compute_frac": breakdown["compute_frac"],
+                "comm_frac": breakdown["comm_frac"],
+                "coordinates": {
+                    cid: {
+                        k: d[k]
+                        for k in (
+                            "compute_frac", "comm_frac", "comm_bytes",
+                            "collective_sites",
+                        )
+                    }
+                    for cid, d in breakdown["coordinates"].items()
+                },
+            }
+        ),
+        **fleet_extras,
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
+
+    if args.num_processes > 1:
+        # exit barrier: process 0 hosts the jax.distributed coordination
+        # service — if it exits while a peer is still auditing/exporting,
+        # that peer is TERMINATED by the coordination client ("leader
+        # task died"). Every worker must reach the end before any leaves.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mesh_fit_worker_exit")
 
 
 if __name__ == "__main__":

@@ -164,3 +164,30 @@ def test_mesh_scaling_band_semantics():
     assert bench.check_quality_bands(
         "glmix_game_estimator", dict(base, mesh={"error": "worker died"})
     )
+    # fleet-leg bands (ISSUE 14): presence-gated — a legacy row without
+    # the section passes, a row that ran the leg is fully gated on max
+    # skew ratio / straggler count / leg failure
+    healthy_fleet = {
+        "max_skew_ratio": 1.05,
+        "stragglers": [],
+        "sweeps_joined": 3,
+    }
+    ok_fleet = dict(base, mesh=dict(healthy_mesh, fleet=healthy_fleet))
+    assert bench.check_quality_bands("glmix_game_estimator", ok_fleet) == []
+    for poison, needle in (
+        ({"max_skew_ratio": 3.5}, "straggler regression"),
+        ({"max_skew_ratio": float("nan")}, "straggler regression"),
+        ({"max_skew_ratio": None}, "straggler regression"),
+        ({"stragglers": [1]}, "straggler(s)"),
+    ):
+        detail = dict(
+            base, mesh=dict(healthy_mesh, fleet=dict(healthy_fleet, **poison))
+        )
+        violations = bench.check_quality_bands(
+            "glmix_game_estimator", detail
+        )
+        assert any(needle in v for v in violations), (poison, violations)
+    assert bench.check_quality_bands(
+        "glmix_game_estimator",
+        dict(base, mesh=dict(healthy_mesh, fleet={"error": "leg timed out"})),
+    )

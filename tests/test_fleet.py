@@ -1,0 +1,724 @@
+"""Fleet observability tests (ISSUE 14).
+
+Covers the cross-process telemetry plane (photon_tpu/obs/fleet.py):
+bucket-exact histogram merging (percentile error vs a pooled-sample
+reference, non-finite outlier buckets, empty-histogram identity),
+counter monotonicity of the aggregated Prometheus families across
+``registry.clear()``, process/fleet namespacing of the obs layout,
+heartbeat staleness, per-sweep start-lateness skew attribution +
+straggler flagging, the fleet publisher's dispatch/read-back
+neutrality + sanitizer cleanliness (the zero-added-syncs acceptance),
+the device-time compute/comm/barrier breakdown, per-process stale-ring
+recovery, and the offline fleet report.
+"""
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu import obs
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator
+from photon_tpu.obs import fleet, flight, http, series
+from photon_tpu.obs.fleet import (
+    FleetPublisher,
+    compute_skew,
+    merge_histograms,
+    merge_snapshots,
+)
+from photon_tpu.obs.metrics import MetricsRegistry, percentile_from_buckets
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.types import TaskType
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    obs.reset()
+    obs.disable()
+    fleet.stop_publisher()
+    flight.disable()
+    series.stop_flusher()
+    yield
+    fleet.stop_publisher()
+    series.stop_flusher()
+    flight.disable()
+    obs.reset()
+    obs.disable()
+
+
+def _opt(max_iterations=4):
+    return GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        optimizer_config=OptimizerConfig(max_iterations=max_iterations),
+    )
+
+
+def _small_fit(seed=3, n=300, users=24, d_fe=5, d_re=3, sweeps=2, **est_kw):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, users, size=n)
+    x = rng.normal(size=(n, d_fe))
+    xr = rng.normal(size=(n, d_re))
+    y = x @ rng.normal(size=d_fe) * 0.3 + rng.normal(size=n) * 0.1
+    data = GameData.build(
+        labels=y,
+        feature_shards={
+            "g": CSRMatrix.from_dense(x),
+            "u": CSRMatrix.from_dense(xr),
+        },
+        id_tags={"userId": [f"u{i}" for i in ids]},
+    )
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="g",
+                optimization=_opt(),
+                regularization_weights=(1.0,),
+            ),
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="u",
+                optimization=_opt(),
+                regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["fixed", "user"],
+        descent_iterations=sweeps,
+        seed=seed,
+        **est_kw,
+    )
+    return est, data
+
+
+def _publisher(tmp_path, index=0, count=2, interval_s=60.0):
+    """A constructed (not thread-started) publisher installed as the
+    process-global one, under ``obs/p<index>``."""
+    info = fleet.ProcessInfo(
+        index=index, count=count, host="testhost", pid=os.getpid()
+    )
+    d = os.path.join(str(tmp_path), "obs", f"p{index}")
+    pub = FleetPublisher(d, interval_s=interval_s, info=info)
+    fleet._publisher = pub
+    return pub
+
+
+# -- bucket-exact histogram merging (satellite) -----------------------------
+
+
+def test_merge_empty_identity():
+    out = merge_histograms([])
+    assert out == {
+        "count": 0, "sum": 0.0, "min": None, "max": None, "buckets": {}
+    }
+    # merging the identity with a histogram returns that histogram
+    r = MetricsRegistry()
+    for v in (1.0, 2.0, 4.0):
+        r.histogram("h", v)
+    h = r.snapshot()["histograms"]["h"]
+    merged = merge_histograms([merge_histograms([]), h])
+    assert merged["count"] == 3
+    assert merged["sum"] == pytest.approx(7.0)
+    assert merged["buckets"] == h["buckets"]
+
+
+def test_merge_is_bucket_exact_vs_pooled_registry():
+    """Merging N per-process histograms must yield EXACTLY the buckets
+    a single registry observing the pooled samples would hold — the
+    merge adds zero resolution loss."""
+    rng = np.random.default_rng(7)
+    parts = [rng.lognormal(0, 1, 400), rng.lognormal(1, 0.5, 250),
+             rng.lognormal(-1, 2, 100)]
+    regs = [MetricsRegistry() for _ in parts]
+    pooled = MetricsRegistry()
+    for reg, vals in zip(regs, parts):
+        for v in vals:
+            reg.histogram("lat", v)
+            pooled.histogram("lat", v)
+    merged = merge_histograms(
+        [r.snapshot()["histograms"]["lat"] for r in regs]
+    )
+    ref = pooled.snapshot()["histograms"]["lat"]
+    assert merged["buckets"] == ref["buckets"]
+    assert merged["count"] == ref["count"]
+    assert merged["sum"] == pytest.approx(ref["sum"])
+    assert merged["min"] == ref["min"] and merged["max"] == ref["max"]
+
+
+def test_merged_percentiles_within_documented_tolerance():
+    """Fleet percentiles from the merged buckets stay within the same
+    ±~5% relative resolution as per-process ones, vs the true pooled
+    sample percentiles."""
+    rng = np.random.default_rng(0)
+    parts = [rng.lognormal(0, 1, 500), rng.lognormal(1, 0.5, 300)]
+    regs = [MetricsRegistry() for _ in parts]
+    for reg, vals in zip(regs, parts):
+        for v in vals:
+            reg.histogram("h", v)
+    merged = merge_snapshots([r.snapshot() for r in regs])
+    pooled = np.concatenate(parts)
+    for q in (50, 90, 99):
+        ref = float(np.percentile(pooled, q))
+        got = merged["histograms"]["h"][f"p{q}"]
+        assert got is not None
+        assert abs(got - ref) / ref < 0.06, (q, got, ref)
+
+
+def test_merge_nonfinite_outlier_buckets():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.histogram("h", 1.0)
+    r1.histogram("h", float("nan"))
+    r2.histogram("h", float("inf"))
+    r2.histogram("h", 2.0)
+    merged = merge_histograms(
+        [r1.snapshot()["histograms"]["h"], r2.snapshot()["histograms"]["h"]]
+    )
+    assert merged["count"] == 4
+    assert merged["nonfinite"] == 2
+    # the outlier ceiling bucket aggregated across processes
+    assert merged["buckets"][str(10**6)] == 2
+    # moments stay finite (non-finite samples never poison the sum)
+    assert math.isfinite(merged["sum"])
+    assert merged["min"] == 1.0 and merged["max"] == 2.0
+    # and the merged histogram still yields percentiles
+    assert percentile_from_buckets(merged, 50) is not None
+
+
+def test_merge_snapshots_sums_counters_and_drops_gauges():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.counter("descent.sweeps", 3)
+    r2.counter("descent.sweeps", 4)
+    r2.counter("io.records", 10)
+    r1.gauge("mem.live_bytes", 100)
+    merged = merge_snapshots([r1.snapshot(), r2.snapshot()])
+    assert merged["counters"]["descent.sweeps"] == 7
+    assert merged["counters"]["io.records"] == 10
+    assert merged["gauges"] == {}  # per-process only (labeled exposition)
+
+
+# -- aggregated-family monotonicity across registry.clear() -----------------
+
+
+def test_fleet_families_monotonic_across_registry_clear(tmp_path):
+    pub = _publisher(tmp_path, index=0, count=2)
+    reg = pub._registry
+    obs.enable()
+    mono = http.CounterMonotonicity()
+
+    reg.counter("descent.sweeps", 5)
+    pub.write_heartbeat()
+    text1 = http.fleet_prometheus_text(mono)
+    fam1 = http.parse_prometheus_text(text1)
+    v1 = fam1["photon_fleet_descent_sweeps_total"]["samples"][0][2]
+    assert v1 == 5
+
+    # the bench per-config reset: raw counters go BACKWARDS
+    reg.clear()
+    reg.counter("descent.sweeps", 2)
+    pub.write_heartbeat()
+    fam2 = http.parse_prometheus_text(http.fleet_prometheus_text(mono))
+    v2 = fam2["photon_fleet_descent_sweeps_total"]["samples"][0][2]
+    assert v2 >= v1  # a Prometheus counter series must never decrease
+    assert v2 == 7  # base folded in: 5 (pre-reset) + 2
+    # per-process family compensated the same way
+    p2 = fam2["photon_proc_descent_sweeps_total"]["samples"][0][2]
+    assert p2 == 7
+
+
+def test_fleet_prometheus_text_per_process_and_aggregate(tmp_path):
+    """ONE scrape carries per-process labeled samples AND the fleet
+    aggregate, with fleet = Σ per-process."""
+    obs.enable()
+    # two fake worker heartbeats under one root
+    root = os.path.join(str(tmp_path), "obs")
+    for k, n in ((0, 3), (1, 4)):
+        reg = MetricsRegistry()
+        reg.counter("descent.sweeps", n)
+        reg.gauge("health.loss.fixed", 0.5 + k)
+        for v in (0.1 * (k + 1), 0.2 * (k + 1)):
+            reg.histogram("descent.sweep_seconds", v)
+        info = fleet.ProcessInfo(index=k, count=2, host="h", pid=100 + k)
+        FleetPublisher(
+            os.path.join(root, f"p{k}"), interval_s=60.0, info=info,
+            registry=reg,
+        ).write_heartbeat()
+    pub = _publisher(tmp_path, index=0, count=2)
+    text = http.fleet_prometheus_text(None)
+    fams = http.parse_prometheus_text(text)
+    procs = fams["photon_proc_descent_sweeps_total"]["samples"]
+    assert {lbl["process"] for _n, lbl, _v in procs} == {"0", "1"}
+    assert sum(v for _n, _l, v in procs) == 7
+    assert fams["photon_fleet_descent_sweeps_total"]["samples"][0][2] == 7
+    # per-process gauges ride with labels; fleet histograms as summaries
+    assert "photon_proc_health_loss_fixed" in fams
+    summ = fams["photon_fleet_descent_sweep_seconds"]
+    assert summ["type"] == "summary"
+    names = {n for n, _l, _v in summ["samples"]}
+    assert "photon_fleet_descent_sweep_seconds_count" in names
+
+
+# -- namespacing / process info ---------------------------------------------
+
+
+def test_process_info_env_override_and_validation(monkeypatch):
+    monkeypatch.setenv("PHOTON_OBS_PROCESS", "1/4")
+    info = fleet.process_info()
+    assert (info.index, info.count) == (1, 4)
+    monkeypatch.setenv("PHOTON_OBS_PROCESS", "4/4")
+    with pytest.raises(ValueError):
+        fleet.process_info()
+    monkeypatch.setenv("PHOTON_OBS_PROCESS", "junk")
+    with pytest.raises(ValueError):
+        fleet.process_info()
+
+
+def test_obs_dir_single_process_layout_unchanged(monkeypatch):
+    monkeypatch.delenv("PHOTON_OBS_PROCESS", raising=False)
+    monkeypatch.delenv("PHOTON_OBS_FLEET", raising=False)
+    assert fleet.obs_dir("/x/y") == os.path.join("/x/y", "obs")
+
+
+def test_obs_dir_namespaced_per_process(monkeypatch):
+    monkeypatch.setenv("PHOTON_OBS_PROCESS", "2/4")
+    assert fleet.obs_dir("/x/y") == os.path.join("/x/y", "obs", "p2")
+    # force-off restores the flat layout even multi-process
+    monkeypatch.setenv("PHOTON_OBS_FLEET", "0")
+    assert fleet.obs_dir("/x/y") == os.path.join("/x/y", "obs")
+    monkeypatch.setenv("PHOTON_OBS_FLEET", "bogus")
+    with pytest.raises(ValueError):
+        fleet.obs_dir("/x/y")
+
+
+def test_fleet_root_of():
+    assert fleet.fleet_root_of("/a/obs/p3") == "/a/obs"
+    assert fleet.fleet_root_of("/a/obs") == "/a/obs"
+    assert fleet.fleet_root_of("/a/obs/px") == "/a/obs/px"
+
+
+# -- heartbeats / staleness -------------------------------------------------
+
+
+def test_heartbeat_doc_and_staleness(tmp_path, monkeypatch):
+    monkeypatch.setenv("PHOTON_OBS_HEARTBEAT_S", "1.0")
+    pub = _publisher(tmp_path, index=1, count=2)
+    obs.enable()
+    doc = pub.write_heartbeat()
+    assert doc["process_index"] == 1 and doc["host"] == "testhost"
+    root = fleet.fleet_root_of(pub.directory)
+    docs = fleet.read_worker_docs(root)
+    assert len(docs) == 1 and docs[0]["process_index"] == 1
+
+    now = doc["heartbeat_wall_s"]
+    assert fleet.worker_status(doc, now + 0.5) == "ok"
+    assert fleet.worker_status(doc, now + 4.0) == "stale"  # > 3 hb
+    assert fleet.worker_status(doc, now + 10.0) == "dead"  # > 9 hb
+    # a clean-stopped worker never goes stale
+    pub.stop()
+    stopped = fleet.read_worker_docs(root)[0]
+    assert stopped["stopped"] is True
+    assert fleet.worker_status(stopped, now + 1e6) == "ok"
+
+
+def test_torn_heartbeat_skipped(tmp_path):
+    d = os.path.join(str(tmp_path), "obs", "p0")
+    os.makedirs(d)
+    with open(os.path.join(d, fleet.REGISTRY_FILENAME), "w") as f:
+        f.write('{"process_index": 0, "trunc')
+    assert fleet.read_worker_docs(os.path.join(str(tmp_path), "obs")) == []
+
+
+# -- skew / straggler -------------------------------------------------------
+
+
+def _sweep_row(p, it, start, sweep_s, barrier_s=0.05):
+    return {
+        "process_index": p,
+        "iteration": it,
+        "start_wall_s": start,
+        "arrival_wall_s": start + sweep_s - barrier_s,
+        "sweep_seconds": sweep_s,
+        "barrier_seconds": barrier_s,
+    }
+
+
+def test_compute_skew_healthy_no_stragglers():
+    rows = {
+        0: [_sweep_row(0, it, 100.0 + it, 0.5) for it in range(3)],
+        1: [_sweep_row(1, it, 100.01 + it, 0.52) for it in range(3)],
+    }
+    skew = compute_skew(rows, straggler_x=2.0)
+    assert len(skew) == 3
+    assert all(r["stragglers"] == [] for r in skew)
+    assert all(r["max_skew_ratio"] < 1.1 for r in skew)
+
+
+def test_compute_skew_flags_late_starter():
+    """The straggler signature measured in the fleet probe: the stalled
+    worker STARTS late with a near-healthy wall, its victim starts on
+    time with an inflated wall (synchronous collectives stretch it)."""
+    rows = {
+        0: [_sweep_row(0, 0, 100.0, 0.5), _sweep_row(0, 1, 101.0, 6.5)],
+        1: [_sweep_row(1, 0, 100.0, 0.5), _sweep_row(1, 1, 107.0, 0.5)],
+    }
+    skew = compute_skew(rows, straggler_x=2.0)
+    assert skew[0]["stragglers"] == []
+    assert skew[0]["warmup"] is True  # first joined iteration of the run
+    bad = skew[1]
+    assert bad["warmup"] is False
+    assert bad["stragglers"] == [1]
+    # lateness 6 s over a 0.5 s unobstructed sweep: ratio = 13
+    assert bad["skew_ratio"]["1"] == pytest.approx(13.0, rel=0.01)
+    assert bad["skew_ratio"]["0"] == 1.0
+    assert bad["start_skew_s"] == pytest.approx(6.0, rel=0.01)
+    assert bad["base_sweep_s"] == pytest.approx(0.5)
+
+
+def test_aggregate_once_emits_straggler_events_exactly_once(tmp_path):
+    obs.enable()
+    pub = _publisher(tmp_path, index=0, count=2, interval_s=60.0)
+    root = fleet.fleet_root_of(pub.directory)
+    for p in (0, 1):
+        os.makedirs(os.path.join(root, f"p{p}"), exist_ok=True)
+        with open(os.path.join(root, f"p{p}", fleet.SWEEPS_FILENAME), "w") as f:
+            # iteration 0 aligned (warm-up never flags); p1 starts
+            # iteration 1 eight seconds late
+            f.write(json.dumps(_sweep_row(p, 0, 100.0, 0.5)) + "\n")
+            start = 101.0 if p == 0 else 109.0
+            f.write(json.dumps(_sweep_row(p, 1, start, 0.5)) + "\n")
+    pub.write_heartbeat()
+    skew = pub.aggregate_once()
+    assert skew and skew[1]["stragglers"] == [1]
+    counters = obs.get_registry().snapshot()["counters"]
+    assert counters["fleet.stragglers"] == 1
+    # a second pass over the same rows must not re-fire the event
+    pub.aggregate_once()
+    counters = obs.get_registry().snapshot()["counters"]
+    assert counters["fleet.stragglers"] == 1
+    gauges = obs.get_registry().snapshot()["gauges"]
+    assert gauges["fleet.workers"] == 1  # one heartbeat doc (p0's)
+    assert gauges["fleet.skew_ratio_max"] == max(
+        r["max_skew_ratio"] for r in skew
+    )
+
+
+def test_record_sweep_appends_rows_and_noop_without_publisher(tmp_path):
+    # no publisher: two module-global reads, no file side effects
+    fleet.record_sweep(0, 0.5, 0.1)
+    pub = _publisher(tmp_path, index=0, count=2)
+    obs.enable()
+    fleet.record_sweep(0, 0.5, 0.1)
+    fleet.record_sweep(1, 0.6, 0.2)
+    pub.stop()
+    rows = fleet.read_sweeps(fleet.fleet_root_of(pub.directory))
+    assert [r["iteration"] for r in rows[0]] == [0, 1]
+    assert rows[0][0]["sweep_seconds"] == 0.5
+    # start = arrival - (sweep - barrier) within rounding
+    r = rows[0][0]
+    assert r["arrival_wall_s"] - r["start_wall_s"] == pytest.approx(
+        0.4, abs=1e-3
+    )
+
+
+def test_record_sweep_discriminates_grid_runs(tmp_path):
+    """Iteration numbers restart per regularization grid point; the
+    publisher bumps a run counter on a non-increasing iteration so
+    compute_skew never joins grid-1's sweep 0 against grid-0's (which
+    would read the whole grid-0 duration as lateness and fire a false,
+    unretractable straggler)."""
+    pub = _publisher(tmp_path, index=0, count=2)
+    obs.enable()
+    pub.record_sweep(0, 0.5, 0.1)
+    pub.record_sweep(1, 0.5, 0.1)
+    pub.record_sweep(0, 0.5, 0.1)  # grid point 1 starts
+    pub.record_sweep(1, 0.5, 0.1)
+    rows = fleet.read_sweeps(fleet.fleet_root_of(pub.directory))[0]
+    assert [(r["run"], r["iteration"]) for r in rows] == [
+        (0, 0), (0, 1), (1, 0), (1, 1)
+    ]
+    # cross-run rows never share a join key, and each run's first
+    # iteration is its own warm-up
+    skew = compute_skew({0: rows}, straggler_x=2.0)
+    assert [(r["run"], r["iteration"], r["warmup"]) for r in skew] == [
+        (0, 0, True), (0, 1, False), (1, 0, True), (1, 1, False)
+    ]
+
+
+def test_max_skew_ratio_excludes_warmup():
+    """The band-gated headline number skips warm-up rows — a gate
+    reading the first sweep's legitimate startup skew would fail
+    healthy runs that straggler flagging correctly declines to flag."""
+    rows = {
+        # a ~1 s cross-process startup delay ONLY at iteration 0
+        0: [_sweep_row(0, 0, 100.0, 0.3), _sweep_row(0, 1, 101.0, 0.3)],
+        1: [_sweep_row(1, 0, 101.0, 0.3), _sweep_row(1, 1, 101.01, 0.3)],
+    }
+    skew = compute_skew(rows, straggler_x=2.0)
+    assert skew[0]["warmup"] and skew[0]["max_skew_ratio"] > 2.0
+    assert all(r["stragglers"] == [] for r in skew)
+    headline = fleet.max_skew_ratio(skew)
+    assert headline is not None and headline < 1.1
+    # warmup-only rows: no steady number to gate
+    assert fleet.max_skew_ratio(skew[:1]) is None
+
+
+def test_obs_reset_clears_sweeps_cache(tmp_path):
+    d = os.path.join(str(tmp_path), "obs", "p0")
+    os.makedirs(d)
+    path = os.path.join(d, fleet.SWEEPS_FILENAME)
+    with open(path, "w") as f:
+        f.write(json.dumps(_sweep_row(0, 0, 100.0, 0.5)) + "\n")
+    root = os.path.join(str(tmp_path), "obs")
+    assert fleet.read_sweeps(root)[0]
+    assert fleet._sweeps_cache  # retained for incremental reads
+    obs.reset()  # run boundary: the cache must not outlive the run
+    assert fleet._sweeps_cache == {}
+    assert fleet.read_sweeps(root)[0]  # re-reads from scratch fine
+
+
+def test_read_sweeps_incremental_and_partial_tail(tmp_path):
+    """The aggregation tick re-reads sweep logs every heartbeat: reads
+    are incremental (only new bytes re-parse) and a flush-torn partial
+    tail line is deferred to the next read, never dropped."""
+    d = os.path.join(str(tmp_path), "obs", "p0")
+    os.makedirs(d)
+    path = os.path.join(d, fleet.SWEEPS_FILENAME)
+    with open(path, "w") as f:
+        f.write(json.dumps(_sweep_row(0, 0, 100.0, 0.5)) + "\n")
+    root = os.path.join(str(tmp_path), "obs")
+    assert len(fleet.read_sweeps(root)[0]) == 1
+    # append one whole row + one PARTIAL line (no newline yet)
+    with open(path, "a") as f:
+        f.write(json.dumps(_sweep_row(0, 1, 101.0, 0.5)) + "\n")
+        f.write('{"process_index": 0, "iteration": 2')
+    rows = fleet.read_sweeps(root)[0]
+    assert [r["iteration"] for r in rows] == [0, 1]
+    # the writer finishes the line: the completed row appears
+    with open(path, "a") as f:
+        f.write(', "start_wall_s": 102.0, "sweep_seconds": 0.5}\n')
+    rows = fleet.read_sweeps(root)[0]
+    assert [r["iteration"] for r in rows] == [0, 1, 2]
+
+
+# -- publisher neutrality (acceptance: zero added dispatches/syncs) ---------
+
+
+def test_fleet_publisher_is_dispatch_and_readback_neutral(
+    tmp_path, monkeypatch
+):
+    import photon_tpu.game.descent as descent_mod
+
+    forces = {"n": 0}
+    real_force = descent_mod.force
+    real_fetch = descent_mod.fetch_scalars
+
+    def counting_force(*a, **kw):
+        forces["n"] += 1
+        return real_force(*a, **kw)
+
+    def counting_fetch(*a, **kw):
+        forces["n"] += 1
+        return real_fetch(*a, **kw)
+
+    monkeypatch.setattr(descent_mod, "force", counting_force)
+    monkeypatch.setattr(descent_mod, "fetch_scalars", counting_fetch)
+
+    def run(fleet_on):
+        obs.reset()
+        obs.enable()
+        fleet.stop_publisher()
+        if fleet_on:
+            _publisher(tmp_path, index=0, count=2).start()
+        est, data = _small_fit(sweeps=3)
+        forces["n"] = 0
+        result = est.fit(data)[0]
+        rows = [
+            r["dispatches"] for r in result.tracker if "sweep_seconds" in r
+        ]
+        return rows, forces["n"]
+
+    rows_off, forces_off = run(fleet_on=False)
+    rows_on, forces_on = run(fleet_on=True)
+    assert rows_on == rows_off
+    assert forces_on == forces_off
+    # and the tap actually recorded rows
+    sweeps = fleet.read_sweeps(os.path.join(str(tmp_path), "obs"))
+    assert len(sweeps.get(0, [])) == 3
+
+
+def test_fleet_tap_clean_under_transfer_sanitizer(tmp_path, monkeypatch):
+    monkeypatch.setenv("PHOTON_SANITIZE", "transfers")
+    obs.enable()
+    _publisher(tmp_path, index=0, count=2)
+    est, data = _small_fit(sweeps=2)
+    est.fit(data)  # raises on any unsanctioned transfer
+    sweeps = fleet.read_sweeps(os.path.join(str(tmp_path), "obs"))
+    assert len(sweeps.get(0, [])) == 2
+
+
+# -- device-time breakdown --------------------------------------------------
+
+
+def test_device_breakdown_published_from_precompiled_fit(tmp_path):
+    obs.enable()
+    est, data = _small_fit(sweeps=3, precompile=True)
+    est.fit(data)
+    bd = fleet.get_breakdown()
+    assert bd is not None
+    total = bd["barrier_frac"] + bd["compute_frac"] + bd["comm_frac"]
+    assert total == pytest.approx(1.0, abs=1e-4)
+    assert set(bd["coordinates"]) == {"fixed", "user"}
+    for d in bd["coordinates"].values():
+        assert d["compute_frac"] >= 0 and d["comm_frac"] >= 0
+    # provenance labels the split honestly
+    assert "cost-model" in bd["provenance"]["comm_compute_split"]
+    gauges = obs.get_registry().snapshot()["gauges"]
+    assert "device.barrier_frac" in gauges
+    assert "device.compute_frac.fixed" in gauges
+    assert "device.comm_frac.user" in gauges
+    # exported artifact set gains breakdown.json + the summary table
+    paths = obs.export_artifacts(str(tmp_path / "obs"))
+    assert "breakdown" in paths
+    with open(paths["breakdown"]) as f:
+        doc = json.load(f)
+    assert doc["breakdown"]["barrier_frac"] == bd["barrier_frac"]
+    with open(paths["summary"]) as f:
+        assert "device-time breakdown" in f.read()
+    # obs.reset clears it (artifact boundary)
+    obs.reset()
+    assert fleet.get_breakdown() is None
+
+
+def test_device_breakdown_none_without_aot_executables():
+    obs.enable()
+    est, data = _small_fit(sweeps=2, precompile=False)
+    est.fit(data)
+    # un-precompiled fit: nothing to price — no breakdown, no crash
+    assert fleet.get_breakdown() is None
+
+
+# -- per-process stale-ring recovery ----------------------------------------
+
+
+def test_recover_stale_scans_process_subdirs(tmp_path):
+    from photon_tpu.obs.flight import FlightRecorder, recover_stale
+
+    root = str(tmp_path / "obs")
+    for k in (0, 1):
+        d = os.path.join(root, f"p{k}")
+        os.makedirs(d)
+        rec = FlightRecorder(
+            os.path.join(d, "blackbox.ring"), capacity_bytes=8192
+        )
+        rec.append("sweep", {"iteration": 5 + k})
+        rec.close(clean=False)  # both workers died dirty
+    out = recover_stale(root)
+    assert out is not None
+    for k in (0, 1):
+        dumps = [
+            f
+            for f in os.listdir(os.path.join(root, f"p{k}"))
+            if f.startswith("blackbox-") and f.endswith(".json")
+        ]
+        assert dumps, f"p{k} ring not recovered"
+        with open(os.path.join(root, f"p{k}", dumps[0])) as f:
+            doc = json.load(f)
+        assert doc["recovered"] is True
+        assert doc["last_sweep"]["iteration"] == 5 + k
+
+
+# -- series rows stamped ----------------------------------------------------
+
+
+def test_series_rows_carry_process_identity_and_heartbeat(tmp_path):
+    obs.enable()
+    obs.counter("x")
+    f = series.SeriesFlusher(str(tmp_path / "s.jsonl"), interval_s=60.0)
+    row = f.flush_once()
+    assert row["process_index"] == 0
+    assert row["host"]
+    # phl-ok: PHL006 test compares the row's wall stamp to wall now
+    assert abs(row["heartbeat_wall_s"] - time.time()) < 30
+
+
+# -- healthz fleet section --------------------------------------------------
+
+
+def test_healthz_reports_fleet_workers_and_stragglers(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("PHOTON_OBS_HEARTBEAT_S", "0.2")
+    obs.enable()
+    pub = _publisher(tmp_path, index=0, count=2, interval_s=60.0)
+    pub.write_heartbeat()
+    root = fleet.fleet_root_of(pub.directory)
+    # a second worker whose heartbeat is already old -> stale/dead
+    info1 = fleet.ProcessInfo(index=1, count=2, host="h", pid=1)
+    p1 = FleetPublisher(
+        os.path.join(root, "p1"), interval_s=60.0, info=info1,
+        registry=MetricsRegistry(),
+    )
+    doc = p1.write_heartbeat()
+    stale_path = os.path.join(root, "p1", fleet.REGISTRY_FILENAME)
+    doc["heartbeat_wall_s"] -= 1e6
+    with open(stale_path, "w") as f:
+        json.dump(doc, f)
+    # and a straggler row for it (iteration 0 is warm-up, 1 flags)
+    os.makedirs(os.path.join(root, "p1"), exist_ok=True)
+    for p, start in ((0, 101.0), (1, 111.0)):
+        with open(
+            os.path.join(root, f"p{p}", fleet.SWEEPS_FILENAME), "a"
+        ) as f:
+            f.write(json.dumps(_sweep_row(p, 0, 100.0, 0.5)) + "\n")
+            f.write(json.dumps(_sweep_row(p, 1, start, 0.5)) + "\n")
+    hz = http.healthz_snapshot()
+    assert hz["process_index"] == 0 and hz["process_count"] >= 1
+    fl = hz["fleet"]
+    assert fl is not None
+    assert [w["process_index"] for w in fl["workers"]] == [0, 1]
+    assert 1 in fl["dead"]
+    assert fl["stragglers"] == [1]
+    assert fl["max_skew_ratio"] > 2.0
+    assert fl["sweeps_joined"] == 2
+
+
+# -- offline report ---------------------------------------------------------
+
+
+def test_fleet_report_document(tmp_path):
+    obs.enable()
+    root = os.path.join(str(tmp_path), "obs")
+    for k in (0, 1):
+        reg = MetricsRegistry()
+        reg.counter("descent.sweeps", 2 + k)
+        info = fleet.ProcessInfo(index=k, count=2, host="h", pid=k)
+        FleetPublisher(
+            os.path.join(root, f"p{k}"), interval_s=60.0, info=info,
+            registry=reg,
+        ).write_heartbeat()
+        with open(
+            os.path.join(root, f"p{k}", fleet.SWEEPS_FILENAME), "w"
+        ) as f:
+            f.write(json.dumps(_sweep_row(k, 0, 100.0, 0.5)) + "\n")
+            f.write(
+                json.dumps(_sweep_row(k, 1, 101.0 + 7 * k, 0.5)) + "\n"
+            )
+    doc = fleet.fleet_report(root)
+    assert len(doc["workers"]) == 2
+    assert doc["fleet"]["counters"]["descent.sweeps"] == 5
+    assert len(doc["skew"]) == 2
+    assert doc["stragglers"][0]["process_index"] == 1
+    assert doc["max_skew_ratio"] > 2.0
+    # the report is JSON-serializable as written by the script
+    json.dumps(doc, default=str)
