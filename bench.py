@@ -234,6 +234,16 @@ QUALITY_BANDS = {
         "cache_parity_max": 1e-6,
         "cache_warm_decode_spans_max": 0,
     },
+    # the Poisson tail-latency config (ROADMAP 2 / ISSUE 15): the
+    # SUSTAINED leg (0.5× measured capacity) must hold its p99 under a
+    # generous wall band (5 s = "not wedged", far above any healthy
+    # batch on even a loaded 2-core builder) AND pass its own armed SLO
+    # gate — a throughput row whose tail blew the objective, or whose
+    # violation census flags a dominant stage, must fail, not publish
+    "game_scoring_tail": {
+        "tail_p99_s_max": 5.0,
+        "tail_slo_ok": True,
+    },
 }
 
 #: ConvergenceReason codes that mean "the tolerance check stopped us"
@@ -356,6 +366,23 @@ def check_quality_bands(name: str, detail: dict) -> list[str]:
                             f"fleet leg flagged {n_strag} straggler(s) "
                             f"(> {strag_max}) in a healthy run"
                         )
+    tail_p99_max = band.get("tail_p99_s_max")
+    if tail_p99_max is not None:
+        tail = detail.get("tail") or {}
+        p99 = tail.get("p99_s")
+        if p99 is None or not math.isfinite(p99) or p99 > tail_p99_max:
+            out.append(
+                f"sustained-leg p99 end-to-end latency {p99} s > "
+                f"{tail_p99_max} s (queueing included — the tail the "
+                "SLO plane exists to see)"
+            )
+    if band.get("tail_slo_ok"):
+        tail = detail.get("tail") or {}
+        if not tail.get("gate_ok"):
+            out.append(
+                "sustained leg breached its armed SLO: "
+                f"{'; '.join(tail.get('slo_violations') or ['no gate data'])}"
+            )
     if band.get("require_memory"):
         mem = detail.get("mem") or {}
         peak = mem.get("peak_bytes")
@@ -407,6 +434,11 @@ CONFIG_PLAN = [
     # write, vs the monolithic materialize-everything path on the same
     # files; compiles one program per batch shape (cheap, AOT)
     ("game_scoring_stream", 900, 2),
+    # open-loop Poisson tail latency over the streaming scorer
+    # (scripts/load_harness.py in-process): capacity calibration, then
+    # paced legs reporting p50/p90/p99/p99.9 end-to-end with queueing
+    # included, gated by the armed SLO
+    ("game_scoring_tail", 900, 2),
 ]
 
 #: BENCH_PARTIAL_PATH redirects the cumulative artifact — a CPU-pinned
@@ -2428,6 +2460,85 @@ def config_scoring_stream(peak_flops, scale):
         shutil.rmtree(out_root, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Config 7 — tail latency under Poisson load (ROADMAP 2 / ISSUE 15):
+# the open-loop load harness (scripts/load_harness.py) drives the
+# streaming scorer with seeded exponential inter-arrivals — arrivals
+# decoupled from completions, each request's latency clock starting at
+# its SCHEDULED arrival (queueing counts; no coordinated omission) —
+# and reports the sustained-QPS vs tail-latency curve. The armed SLO
+# gates the run (QUALITY_BANDS: p99 wall band + the gate verdict).
+# ---------------------------------------------------------------------------
+
+
+def config_scoring_tail(peak_flops, scale):
+    del peak_flops
+    from photon_tpu import obs
+
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"
+    )
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import load_harness
+
+    num_requests, batch_rows, users, d, nnz = _pick(
+        scale,
+        (16, 256, 64, 16, 8),
+        (48, 2048, 512, 32, 16),
+        (64, 8192, 4096, 64, 24),
+    )
+    # the spec is deliberately loose at bench scale: it gates "the tail
+    # did not detonate under sustained sub-capacity load" (a stall, a
+    # retrace, a backed-up queue), not a hero number — the harness CLI
+    # is where tight-budget experiments run
+    spec = "p99<=5s@60s"
+    obs_dir = os.environ.get("PHOTON_OBS_DIR", "bench_obs")
+    series_flusher = _start_series_flusher("game_scoring_tail")
+    try:
+        doc = load_harness.run_load(
+            "auto",
+            num_requests=num_requests,
+            batch_rows=batch_rows,
+            spec=spec,
+            seed=15,
+            out_dir=obs_dir,
+            prefix="game_scoring_tail.",
+            workload_kwargs={"users": users, "d": d, "nnz": nnz},
+        )
+    finally:
+        series_path = _stop_series_flusher(series_flusher)
+        obs.reset()
+    paths = doc["artifacts"]
+    sustained = doc["legs"][0]
+    top = doc["legs"][-1]
+    return {
+        "n": num_requests * batch_rows,
+        "batch_rows": batch_rows,
+        "num_requests": num_requests,
+        "spec": doc["spec"],
+        "capacity_qps": doc["capacity_qps"],
+        "points": doc["legs"],
+        # the banded headline: the SUSTAINED (0.5× capacity) leg's tail
+        "tail": {
+            "offered_qps": sustained["offered_qps"],
+            "p50_s": sustained["latency_s"].get("p50"),
+            "p99_s": sustained["latency_s"].get("p99"),
+            "p99_9_s": sustained["latency_s"].get("p99.9"),
+            "violations": sustained["violations"],
+            "violations_by_stage": sustained["violations_by_stage"],
+            "gate_ok": sustained["gate_ok"],
+            "slo_violations": sustained["slo_violations"],
+        },
+        "examples_per_sec": top["samples_per_sec"],
+        "obs": {
+            "slo_report_path": paths.get("slo"),
+            "metrics_path": paths.get("metrics"),
+            "series_path": series_path,
+        },
+    }
+
+
 CONFIG_FNS = {
     "a1a_logistic_lbfgs": config_a1a,
     "linear_tron": config_tron,
@@ -2435,6 +2546,7 @@ CONFIG_FNS = {
     "glmix_game_estimator": config_glmix_estimator,
     "game_ctr_scale": config_game_ctr_scale,
     "game_scoring_stream": config_scoring_stream,
+    "game_scoring_tail": config_scoring_tail,
 }
 
 

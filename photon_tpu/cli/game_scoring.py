@@ -244,6 +244,9 @@ def _score_streaming(
             for t, v in tag_acc.items()
         },
     }
+    from photon_tpu.obs import slo
+
+    tracker = slo.active()
     detail = {
         "mode": "streaming",
         "batchRows": batch_rows,
@@ -251,6 +254,22 @@ def _score_streaming(
         "batches": result.stats.batches,
         "maxStagedChunks": result.stats.max_staged_chunks,
         "batchLatency": result.stats.latency_percentiles(),
+        # the per-stage latency waterfall (p50/p90/p99 per pipeline
+        # stage) + end-to-end percentiles incl. p99.9 — a slow run's
+        # summary names decode-vs-H2D-vs-write, not a bare aggregate
+        "stageLatency": result.stats.stage_percentiles(),
+        "e2eLatency": result.stats.e2e_percentiles(),
+        "slo": (
+            None
+            if tracker is None
+            else {
+                "spec": tracker.spec.render(),
+                "violations": result.stats.deadline_violations,
+                "violationsByStage": dict(
+                    result.stats.violations_by_stage
+                ),
+            }
+        ),
         "outputFiles": writer.paths(),
         "featureCache": resolved.describe(),
     }

@@ -40,6 +40,21 @@ Every stage runs under ``obs`` spans (``score.decode`` / ``score.ingest``
 / ``score.h2d`` / ``score.readback`` / ``score.write`` inside a
 ``score.stream`` root) with ``score.batches`` / ``score.samples`` /
 ``score.padded_rows`` counters and a ``score.batch_seconds`` histogram.
+
+**Latency lifecycle (the SLO plane's input).** Each batch additionally
+carries a monotonic BIRTH timestamp — the load source's scheduled
+arrival stamp (``chunk.slo_arrival_t``, ``time.perf_counter`` timebase;
+``scripts/load_harness.py`` sets it so queueing delay counts against
+the budget — no coordinated omission) or, absent one, the moment its
+chunk decode began. Per-batch stage walls (``queue`` hand-off wait,
+``decode``, ``assemble``, ``h2d``, ``dispatch``, ``pipeline`` —
+the double-buffer read-back hold — ``readback``, ``write``) feed
+``score.stage_seconds.<stage>`` histograms, end-to-end
+birth→done walls feed ``score.e2e_seconds``, and each finished batch
+reports to :mod:`photon_tpu.obs.slo` — a batch that blows the armed
+deadline increments a violation counter tagged with its DOMINANT stage,
+so a p99 regression names decode-vs-H2D-vs-write instead of a bare
+number.
 """
 from __future__ import annotations
 
@@ -56,6 +71,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu import obs
+from photon_tpu.obs import slo
 from photon_tpu.game.data import (
     GameData,
     _ceil_pow2,
@@ -234,6 +250,17 @@ class StreamStats:
     batch_retries: int = 0
     #: per-batch dispatch→read-back walls (batch 0 pays the compiles)
     batch_walls_s: list = dataclasses.field(default_factory=list)
+    #: per-batch END-TO-END walls: birth (scheduled arrival when the
+    #: load source stamps ``slo_arrival_t``, else decode start) → batch
+    #: fully finished (scores written) — queueing included
+    e2e_walls_s: list = dataclasses.field(default_factory=list)
+    #: per-stage walls, one list per lifecycle stage (queue / decode /
+    #: assemble / h2d / dispatch / readback / write)
+    stage_walls_s: dict = dataclasses.field(default_factory=dict)
+    #: batches that blew the armed SLO deadline (0 when no SLO armed),
+    #: and the census by dominant stage
+    deadline_violations: int = 0
+    violations_by_stage: dict = dataclasses.field(default_factory=dict)
     #: compile_watch delta over the whole stream / over batch 0 only
     compiles: dict = dataclasses.field(default_factory=dict)
     compiles_first_batch: dict = dataclasses.field(default_factory=dict)
@@ -250,6 +277,40 @@ class StreamStats:
             for p in (50, 95, 99)
         }
 
+    def e2e_percentiles(self, warm_only: bool = False) -> dict:
+        """Exact (numpy, not bucketed) p50/p90/p99/p99.9 of end-to-end
+        batch latency — queueing delay included. All batches by default:
+        an open-loop load report must not exclude the cold batch its
+        arrivals already charged."""
+        walls = self.e2e_walls_s[1:] if warm_only else self.e2e_walls_s
+        if not walls:
+            return {}
+        arr = np.asarray(walls)
+        out = {
+            # phl-ok: PHL002 post-run numpy percentile of host walls, no device value involved
+            f"p{p:g}": round(float(np.percentile(arr, p)), 6)
+            for p in (50, 90, 99, 99.9)
+        }
+        # phl-ok: PHL002 post-run numpy moment of host walls, no device value involved
+        out["mean"] = round(float(arr.mean()), 6)
+        # phl-ok: PHL002 post-run numpy moment of host walls, no device value involved
+        out["max"] = round(float(arr.max()), 6)
+        return out
+
+    def stage_percentiles(self) -> dict:
+        """Exact per-stage p50/p90/p99 — the latency waterfall
+        ``scoring-summary.json`` carries."""
+        out = {}
+        for stage, walls in self.stage_walls_s.items():
+            if not walls:
+                continue
+            arr = np.asarray(walls)
+            out[stage] = {
+                f"p{p}": round(float(np.percentile(arr, p)), 6)
+                for p in (50, 90, 99)
+            }
+        return out
+
 
 @dataclasses.dataclass
 class StreamResult:
@@ -262,6 +323,21 @@ class StreamResult:
 class _Failure:
     def __init__(self, exc: BaseException):
         self.exc = exc
+
+
+@dataclasses.dataclass
+class _ChunkItem:
+    """One decoded chunk plus its latency-lifecycle stamps (all
+    ``time.perf_counter`` timebase): ``birth_t`` is the load source's
+    scheduled-arrival stamp when present (``chunk.slo_arrival_t`` —
+    open-loop harnesses set it so queueing counts against the deadline)
+    or the moment decode began; ``decoded_t`` anchors the consumer's
+    hand-off ``queue`` wait."""
+
+    chunk: GameData
+    birth_t: float
+    decode_s: float
+    decoded_t: float
 
 
 class _StageCounter:
@@ -645,6 +721,7 @@ class GameScorer:
 
         try:
             while not stop.is_set():
+                t_pull = time.perf_counter()
                 with obs.span("score.decode"):
                     # chaos hook inside the try: a decode fault reports
                     # through the normal _Failure hand-off (the source's
@@ -652,15 +729,32 @@ class GameScorer:
                     # the time an error reaches here)
                     faults.fault_point("scoring.chunk")
                     chunk = next(chunk_iter, _DONE)
+                t_decoded = time.perf_counter()
                 if chunk is _DONE:
                     put(_DONE)
                     return
+                # birth: the load source's scheduled-arrival stamp wins
+                # (open-loop Poisson harness — queueing delay counts),
+                # else the batch is born when its decode began. The
+                # decode stage clips to POST-birth wall: a paced source
+                # sleeping until the scheduled arrival inside next() is
+                # idle time before the request exists, not decode work —
+                # charging it would misname the dominant stage
+                arrival = getattr(chunk, "slo_arrival_t", None)
+                # phl-ok: PHL002 parses a host monotonic stamp the load source attached, not device data
+                birth = t_pull if arrival is None else float(arrival)
+                item = _ChunkItem(
+                    chunk=chunk,
+                    birth_t=birth,
+                    decode_s=max(0.0, t_decoded - max(t_pull, birth)),
+                    decoded_t=t_decoded,
+                )
                 with staged.lock:
                     staged.value += 1
                     stats.max_staged_chunks = max(
                         stats.max_staged_chunks, staged.value
                     )
-                if not put(chunk):
+                if not put(item):
                     return
         except BaseException as e:  # propagate into the consumer loop
             put(_Failure(e))
@@ -717,6 +811,10 @@ class GameScorer:
         concatenates all scores (cheap: 8 bytes/row; it is the feature
         blocks that streaming keeps off the host)."""
         stats = StreamStats()
+        # arm the latency SLO from PHOTON_SLO_SPEC (no-op when unset or
+        # when a tracker was installed programmatically) — driver runs
+        # get deadline tracking with no code change
+        slo.ensure_from_env()
         collected: list[np.ndarray] = [] if collect_scores else None
         q: queue.Queue = queue.Queue(maxsize=MAX_STAGED_CHUNKS - 1)
         stop = threading.Event()
@@ -731,7 +829,14 @@ class GameScorer:
         )
 
         def finish(pending) -> None:
-            dev_scores, chunk, t_dispatch = pending
+            dev_scores, item, t_dispatch, stages, t_enqueued = pending
+            chunk = item.chunk
+            t_r0 = time.perf_counter()
+            # the double-buffer hold: batch i's read-back is deferred
+            # until batch i+1 enqueues — real latency from this batch's
+            # perspective, attributed explicitly so it can't masquerade
+            # as (or hide behind) another stage
+            stages["pipeline"] = t_r0 - t_enqueued
             with obs.span("score.readback", rows=chunk.num_samples):
                 obs.memory.count_d2h(int(dev_scores.nbytes))
                 with sanctioned_transfers(
@@ -741,6 +846,7 @@ class GameScorer:
                     scores = np.asarray(dev_scores)[
                         : chunk.num_samples
                     ].astype(np.float64)
+            stages["readback"] = time.perf_counter() - t_r0
             wall = time.perf_counter() - t_dispatch
             if not stats.batch_walls_s:
                 stats.compiles_first_batch = compile_watch.delta(cw_start)
@@ -750,6 +856,30 @@ class GameScorer:
             obs.counter("score.batches")
             obs.counter("score.samples", chunk.num_samples)
             obs.histogram("score.batch_seconds", wall)
+            if collected is not None:
+                collected.append(scores)
+            if on_batch is not None:
+                t_w0 = time.perf_counter()
+                with obs.span("score.write", rows=chunk.num_samples):
+                    on_batch(chunk, scores)
+                stages["write"] = time.perf_counter() - t_w0
+            # the batch's latency lifecycle closes HERE: end-to-end wall
+            # from birth (scheduled arrival / decode start) through the
+            # sink write, per-stage walls into their histograms, and the
+            # SLO verdict — a blown deadline is tagged with the stage
+            # that ate the budget
+            e2e = time.perf_counter() - item.birth_t
+            stats.e2e_walls_s.append(e2e)
+            for stage, sec in stages.items():
+                stats.stage_walls_s.setdefault(stage, []).append(sec)
+                obs.histogram(f"score.stage_seconds.{stage}", sec)
+            obs.histogram("score.e2e_seconds", e2e)
+            dominant = slo.observe_batch(e2e, stages)
+            if dominant is not None:
+                stats.deadline_violations += 1
+                stats.violations_by_stage[dominant] = (
+                    stats.violations_by_stage.get(dominant, 0) + 1
+                )
             # flight-recorder tap at the read-back choke point: host
             # values the batch's sanctioned D2H already produced
             obs.flight.record(
@@ -757,12 +887,9 @@ class GameScorer:
                 batch=stats.batches,
                 rows=chunk.num_samples,
                 wall_s=round(wall, 6),
+                e2e_s=round(e2e, 6),
+                violation_stage=dominant,
             )
-            if collected is not None:
-                collected.append(scores)
-            if on_batch is not None:
-                with obs.span("score.write", rows=chunk.num_samples):
-                    on_batch(chunk, scores)
 
         # the transfer sanitizer (PHOTON_SANITIZE=transfers, a no-op
         # otherwise): any IMPLICIT host transfer in the consumer loop —
@@ -789,7 +916,15 @@ class GameScorer:
                         break
                     with staged.lock:
                         staged.value -= 1
-                    chunk = item
+                    chunk = item.chunk
+                    t_pickup = time.perf_counter()
+                    # stage walls for this batch's lifecycle: decode
+                    # measured by the producer, queue = hand-off wait
+                    # (double-buffer backpressure included)
+                    stages = {
+                        "decode": item.decode_s,
+                        "queue": t_pickup - item.decoded_t,
+                    }
                     if stats.batches == 0 and not stats.batch_walls_s:
                         # ingest provenance on the stream root: "cache"
                         # chunks came from the mmap replay (zero decode)
@@ -806,6 +941,7 @@ class GameScorer:
                             "score.padded_rows",
                             self.batch_rows - chunk.num_samples,
                         )
+                    stages["assemble"] = time.perf_counter() - t_pickup
 
                     # per-batch retry-with-requeue: the decoded chunk is
                     # still on host, so a transient H2D/dispatch failure
@@ -813,13 +949,17 @@ class GameScorer:
                     # killing the stream (util/retry.py classifier:
                     # non-transient errors propagate on attempt 1)
                     tries = 0
+                    h2d_acc = [0.0]
 
-                    def run_batch(host_batch=host_batch, key=key):
+                    def run_batch(
+                        host_batch=host_batch, key=key, h2d_acc=h2d_acc
+                    ):
                         nonlocal tries
                         tries += 1
                         # chaos hook: a transient fault here exercises
                         # the requeue path end to end
                         faults.fault_point("scoring.batch")
+                        t_h0 = time.perf_counter()
                         with obs.span("score.h2d"), sanctioned_transfers(
                             "scoring H2D staging — the batch pytree is "
                             "placed whole, explicitly, once per batch"
@@ -831,6 +971,7 @@ class GameScorer:
                             obs.memory.count_h2d(
                                 obs.memory.tree_device_bytes(batch_dev)
                             )
+                        h2d_acc[0] += time.perf_counter() - t_h0
                         return self._dispatch(batch_dev, key)
 
                     t_dispatch = time.perf_counter()
@@ -840,6 +981,14 @@ class GameScorer:
                         classify=is_transient,
                         label="score_batch",
                     )
+                    # stage split: h2d = the placement walls (across
+                    # retries); dispatch = everything else in the retry
+                    # path — the async enqueue, injected pre-H2D faults,
+                    # and retry backoff sleeps all charge here
+                    stages["h2d"] = h2d_acc[0]
+                    stages["dispatch"] = (
+                        time.perf_counter() - t_dispatch
+                    ) - h2d_acc[0]
                     if tries > 1:
                         stats.batch_retries += tries - 1
                         obs.counter("score.batch_retries", tries - 1)
@@ -849,7 +998,10 @@ class GameScorer:
                     # this one
                     if pending is not None:
                         finish(pending)
-                    pending = (dev_scores, chunk, t_dispatch)
+                    pending = (
+                        dev_scores, item, t_dispatch, stages,
+                        time.perf_counter(),
+                    )
                 if pending is not None and failure is None:
                     finish(pending)
             finally:

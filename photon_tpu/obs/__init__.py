@@ -50,7 +50,7 @@ from __future__ import annotations
 import logging
 import os
 
-from photon_tpu.obs import fleet, flight, health, http, memory, series
+from photon_tpu.obs import fleet, flight, health, http, memory, series, slo
 from photon_tpu.obs.export import (
     chrome_trace,
     export_artifacts,
@@ -93,6 +93,7 @@ __all__ = [
     "phase_summary",
     "reset",
     "series",
+    "slo",
     "span",
     "summary_table",
     "write_chrome_trace",
@@ -141,6 +142,7 @@ def reset() -> None:
     memory.get_ledger().reset_run_state()
     fleet.clear_breakdown()
     fleet.clear_sweeps_cache()
+    slo.reset_run_state()
 
 
 def span(name: str, cat: str = "phase", **args) -> Span:

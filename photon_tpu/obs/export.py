@@ -192,6 +192,20 @@ def export_artifacts(
             json.dump(_json_safe({**(meta or {}), "breakdown": bd}), f,
                       indent=2, sort_keys=True)
         paths["breakdown"] = bd_path
+    # the latency-SLO report (photon_tpu/obs/slo.py): spec + violation
+    # census + burn rates + the per-stage latency waterfall — written
+    # only when an SLO is armed or batch latencies were observed, so
+    # non-serving runs keep the historical artifact layout
+    from photon_tpu.obs import slo as obs_slo
+
+    _, registry_r = _resolve(None, registry)
+    slo_doc = obs_slo.report(registry_r)
+    if obs_slo.reportable(slo_doc):
+        slo_path = _path("slo_report.json")
+        with open(slo_path, "w") as f:
+            json.dump(_json_safe({**(meta or {}), "slo": slo_doc}), f,
+                      indent=2, sort_keys=True)
+        paths["slo"] = slo_path
     summary_path = _path("summary.txt")
     with open(summary_path, "w") as f:
         f.write(summary_table(tracer) + "\n")

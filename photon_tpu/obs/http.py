@@ -14,7 +14,12 @@ loop is observable while it runs instead of only after it exports:
 - ``/healthz`` — JSON: last per-coordinate health scalars (the values
   the per-sweep barrier fetched), divergence state, ``recovery.*``
   restart counters, producer-watchdog liveness, series-flusher and
-  flight-recorder liveness.
+  flight-recorder liveness, and the latency-SLO state (armed spec,
+  violation count, burn rates).
+- ``/slo`` — the full latency-SLO document
+  (:func:`photon_tpu.obs.slo.report`): spec, current burn rates,
+  violation census by dominant stage, and the per-stage
+  p50/p90/p99/p99.9 latency waterfall.
 - ``/blackbox`` — the flight recorder's recent ring as JSON.
 
 Zero new dependencies: the exposition writer AND the minimal parser
@@ -326,6 +331,34 @@ def parse_prometheus_text(text: str) -> dict[str, dict]:
 # -- /healthz ---------------------------------------------------------------
 
 
+def slo_health_section() -> dict:
+    """The latency-SLO slice of ``/healthz``: armed spec, violation
+    census, burn rates, and a one-word status — ``ok`` / ``violating``
+    (any burn window over 1.0, or any violation with no window data
+    yet) / ``unarmed``. Pure host reads of the tracker state."""
+    from photon_tpu.obs import slo
+
+    tracker = slo.ensure_from_env()
+    if tracker is None:
+        return {"status": "unarmed", "spec": None}
+    burn = tracker.burn_rates()
+    rates = [b["rate"] for b in burn.values()]
+    burning = any(r is not None and r > 1.0 for r in rates)
+    # violations with NO live window data (the breach aged out of every
+    # burn window, e.g. an idle process after a bad burst) must still
+    # read as violating — nothing observed since says it recovered
+    if tracker.violations and all(r is None for r in rates):
+        burning = True
+    return {
+        "status": "violating" if burning else "ok",
+        "spec": tracker.spec.render(),
+        "batches": tracker.batches,
+        "violations": tracker.violations,
+        "violations_by_stage": dict(tracker.by_stage),
+        "burn_rates": burn,
+    }
+
+
 def healthz_snapshot(registry=None) -> dict:
     """The liveness/health document ``/healthz`` serves, built from the
     registry plus the flight recorder's and series flusher's own state.
@@ -367,6 +400,7 @@ def healthz_snapshot(registry=None) -> dict:
             "stream_stalls": counters.get("score.stream_stalls", 0),
             "batch_retries": counters.get("score.batch_retries", 0),
         },
+        "slo": slo_health_section(),
     }
     rec = flight.get_recorder()
     doc["recorder"] = (
@@ -439,6 +473,13 @@ class _Handler(BaseHTTPRequestHandler):
                     json.dumps(healthz_snapshot(), default=str) + "\n"
                 ).encode()
                 ctype = "application/json"
+            elif self.path.split("?")[0] == "/slo":
+                from photon_tpu.obs import slo
+
+                body = (
+                    json.dumps(slo.report(), default=str) + "\n"
+                ).encode()
+                ctype = "application/json"
             elif self.path.split("?")[0] == "/blackbox":
                 from photon_tpu.obs import flight
 
@@ -497,7 +538,7 @@ class TelemetryServer:
         self._thread.start()
         logger.info(
             "obs endpoints live at http://127.0.0.1:%d"
-            "{/metrics,/healthz,/blackbox}", self.port,
+            "{/metrics,/healthz,/slo,/blackbox}", self.port,
         )
         return self.port
 

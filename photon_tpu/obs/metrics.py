@@ -30,8 +30,10 @@ import threading
 _BUCKET_BASE = 1.1
 _LOG_BASE = math.log(_BUCKET_BASE)
 
-#: percentiles the snapshot (and the .summary.txt exporter) report
-SUMMARY_PERCENTILES = (50, 90, 99)
+#: percentiles the snapshot (and the .summary.txt exporter) report —
+#: p99.9 included since the latency-SLO plane (docs/DESIGN.md
+#: §Observability, "Latency SLO taxonomy") gates the deep tail
+SUMMARY_PERCENTILES = (50, 90, 99, 99.9)
 
 
 def _bucket_index(value: float) -> int:
@@ -62,7 +64,15 @@ def _bucket_value(index: int) -> float:
 def percentile_from_buckets(h: dict, q: float) -> float | None:
     """The q-th percentile (0–100) from a histogram's snapshot dict —
     exposed as a function so exporters and offline consumers of
-    ``metrics.json`` can summarize without a live registry."""
+    ``metrics.json`` can summarize without a live registry.
+
+    Within the bucket the target rank lands in, the value interpolates
+    log-linearly by rank fraction (midpoint-rank convention: a
+    single-sample bucket reads its geometric midpoint, exactly the old
+    behavior) instead of snapping to the midpoint — a densely populated
+    bucket then resolves its interior, which is what p99.9 needs when
+    the tail mass piles into one ×1.1 bucket. Accuracy stays bounded by
+    the bucket width (±~5% relative) in the worst case."""
     count = h.get("count", 0)
     buckets = h.get("buckets")
     if not count or not buckets:
@@ -70,17 +80,22 @@ def percentile_from_buckets(h: dict, q: float) -> float | None:
     target = max(1, math.ceil(count * q / 100.0))
     seen = 0
     for idx in sorted(int(k) for k in buckets):
-        seen += buckets[str(idx)] if str(idx) in buckets else buckets[idx]
-        if seen >= target:
-            # clamp into the observed range: the log-midpoint of the
-            # extreme buckets can overshoot the true min/max (min/max
-            # are None when every sample so far was non-finite)
-            v = _bucket_value(idx)
+        c = buckets[str(idx)] if str(idx) in buckets else buckets[idx]
+        if seen + c >= target:
+            if idx in (-(10**6), 10**6):
+                v = _bucket_value(idx)  # outlier floors/ceilings don't
+            else:  # interpolate — they have no meaningful edges
+                frac = min(1.0, max(0.0, (target - seen - 0.5) / c))
+                v = _BUCKET_BASE ** (idx + frac)
+            # clamp into the observed range: the log interpolation of
+            # the extreme buckets can overshoot the true min/max
+            # (min/max are None when every sample so far was non-finite)
             lo = h.get("min")
             hi = h.get("max")
             lo = v if lo is None else lo
             hi = v if hi is None else hi
             return min(max(v, lo), hi)
+        seen += c
     return h.get("max")
 
 

@@ -7,9 +7,14 @@ The series flusher turns the registry into a TRAJECTORY: a background
 thread appends one row per ``PHOTON_OBS_FLUSH_S`` seconds to
 ``<output>/obs/series.jsonl``, each row carrying the counter DELTAS
 since the previous row (rates fall out as ``delta / interval_s``), the
-current gauges, and per-histogram count deltas + p50/p90/p99. Rows also
-mirror into the flight recorder ring (kind ``metrics``), so a crashed
-run's blackbox holds its last metric deltas, not nothing.
+current gauges, and per-histogram count deltas + PER-INTERVAL
+percentiles (computed from the interval's bucket deltas, not the
+cumulative registry state — a tail that degrades late in a run must
+show in the late rows, which is exactly what ``bench_trend.py
+--p99-tolerance`` gates; an interval where the histogram didn't move
+reports None). Rows also mirror into the flight recorder ring (kind
+``metrics``), so a crashed run's blackbox holds its last metric
+deltas, not nothing.
 
 Row schema (one JSON object per line)::
 
@@ -101,7 +106,34 @@ class SeriesFlusher:
         and mirror it into the flight ring. Returns the row (None on
         write failure — the flusher must never fail the run)."""
         from photon_tpu.obs import flight
-        from photon_tpu.obs.metrics import SUMMARY_PERCENTILES
+        from photon_tpu.obs.metrics import (
+            SUMMARY_PERCENTILES,
+            percentile_from_buckets,
+        )
+
+        def interval_hist(h: dict, prev: dict) -> dict:
+            """Count delta + percentiles of THIS interval's samples:
+            bucket-count deltas vs the previous flush (negative deltas
+            — a registry.clear() between flushes — clamp away, leaving
+            None percentiles for that torn interval). No min/max for
+            the interval, so the percentile read is unclamped — still
+            within the ±~5% bucket resolution."""
+            pb = prev.get("buckets", {})
+            db = {}
+            for k, c in h.get("buckets", {}).items():
+                d = c - pb.get(k, 0)
+                if d > 0:
+                    db[k] = d
+            dcount = sum(db.values())
+            return {
+                "count": h["count"] - prev.get("count", 0),
+                **{
+                    f"p{p}": percentile_from_buckets(
+                        {"count": dcount, "buckets": db}, p
+                    )
+                    for p in SUMMARY_PERCENTILES
+                },
+            }
 
         with self._lock:
             now = time.perf_counter()
@@ -131,14 +163,7 @@ class SeriesFlusher:
                 },
                 "gauges": dict(sorted(delta["gauges"].items())),
                 "histograms": {
-                    name: {
-                        "count": h["count"]
-                        - prev_h.get(name, {}).get("count", 0),
-                        **{
-                            f"p{p}": h.get(f"p{p}")
-                            for p in SUMMARY_PERCENTILES
-                        },
-                    }
+                    name: interval_hist(h, prev_h.get(name, {}))
                     for name, h in sorted(snap["histograms"].items())
                 },
             }

@@ -41,6 +41,13 @@ LAST interval's rate dropping below ``R × peak`` rate is a within-run
 decay failure (exit 3). Default metric ``auto`` picks the busiest of
 ``score.samples`` / ``descent.sweeps`` / ``io.records``.
 
+**Within-run tail creep** (``--p99-tolerance``): the same series rows
+carry per-interval histogram percentiles, so the p99 of
+``score.e2e_seconds`` (the SLO plane's end-to-end batch latency,
+queueing included — ``--p99-metric`` overrides) becomes a trajectory
+too; the last interval's p99 exceeding ``R ×`` the run's best interval
+is a tail regression (exit 3) the terminal aggregate can't see.
+
 Usage::
 
     python scripts/bench_trend.py                        # history table only
@@ -332,6 +339,67 @@ def judge_series_file(
     return v
 
 
+def series_p99_values(rows: list[dict], metric: str) -> list[float]:
+    """Per-interval p99 of ``metric``'s histogram across a series
+    trajectory — only intervals where the histogram moved (count delta
+    != 0) and reported a p99."""
+    out = []
+    for row in rows:
+        h = (row.get("histograms") or {}).get(metric)
+        if not h or not h.get("count"):
+            continue
+        p99 = h.get("p99")
+        if p99 is not None:
+            out.append(float(p99))
+    return out
+
+
+def judge_series_p99(
+    path: str, metric: str, tolerance: float | None
+) -> dict:
+    """Within-run TAIL-creep verdict for one trajectory file: the
+    per-interval p99 of a latency histogram (default
+    ``score.e2e_seconds`` — the SLO plane's end-to-end batch latency,
+    queueing included) must not creep past ``tolerance ×`` the run's
+    best interval. The signal a terminal p99 can't see: a stream whose
+    tail degraded from 10 ms to 200 ms over the run still snapshots a
+    "fine" aggregate if most batches ran early. Fewer than 3 measurable
+    intervals is report-only."""
+    rows = load_series_rows(path)
+    values = series_p99_values(rows, metric)
+    v: dict = {
+        "file": os.path.basename(path),
+        "metric": f"{metric}:p99",
+        "status": "ok",
+        "notes": [],
+        "intervals": len(values),
+        "p99s": [round(x, 6) for x in values],
+    }
+    if len(values) < 3:
+        v["notes"].append(
+            f"only {len(values)} p99 interval(s) — report-only "
+            "(tail creep needs a trajectory)"
+        )
+        return v
+    best = min(values)
+    last = values[-1]
+    v["best_p99"] = round(best, 6)
+    v["last_p99"] = round(last, 6)
+    v["last_over_best"] = round(last / best, 3) if best > 0 else None
+    if (
+        tolerance is not None
+        and best > 0
+        and last > tolerance * best
+    ):
+        v["status"] = "fail"
+        v["notes"].append(
+            f"within-run tail creep: last interval p99 {last:.4g}s is "
+            f"{last / best:.2f}x the run's best {best:.4g}s "
+            f"(tolerance {tolerance:.2f}x)"
+        )
+    return v
+
+
 def judge_northstar(paths: list[str]) -> tuple[list[dict], list[str]]:
     """The SCALE_NORTHSTAR_r*.json series as a gated trajectory: each
     round's coefficient count, per-device footprint, padding waste and
@@ -463,6 +531,22 @@ def main(argv=None) -> int:
         "drops below R x the run's peak rate (unset: report only)",
     )
     ap.add_argument(
+        "--p99-metric",
+        default="score.e2e_seconds",
+        help="latency histogram whose per-interval p99 is the "
+        "within-run TAIL signal (default score.e2e_seconds — the SLO "
+        "plane's end-to-end batch latency)",
+    )
+    ap.add_argument(
+        "--p99-tolerance",
+        type=float,
+        default=None,
+        metavar="R",
+        help="gate within-run tail creep over --series files: fail "
+        "(exit 3) when the last interval's p99 exceeds R x the run's "
+        "best interval p99 (unset: report only)",
+    )
+    ap.add_argument(
         "--skew-tolerance",
         type=float,
         default=None,
@@ -540,7 +624,29 @@ def main(argv=None) -> int:
                 f"[{marker}] within-run {v['file']} "
                 f"({v.get('metric')}/s) {spark}{rate} {notes}".rstrip()
             )
-    failed_series = [v for v in series_verdicts if v["status"] == "fail"]
+    p99_verdicts: list[dict] = []
+    if args.series:
+        for path in sorted(glob.glob(args.series)):
+            v = judge_series_p99(path, args.p99_metric, args.p99_tolerance)
+            if v["intervals"] == 0:
+                continue  # run never observed the latency histogram
+            p99_verdicts.append(v)
+            marker = "FAIL" if v["status"] == "fail" else "ok"
+            creep = (
+                f" last/best {v['last_over_best']}x"
+                if "last_over_best" in v
+                else ""
+            )
+            notes = "; ".join(v["notes"]) if v["notes"] else ""
+            print(
+                f"[{marker}] within-run tail {v['file']} "
+                f"({v['metric']}){creep} {notes}".rstrip()
+            )
+    failed_series = [
+        v
+        for v in series_verdicts + p99_verdicts
+        if v["status"] == "fail"
+    ]
 
     northstar_rows: list[dict] = []
     northstar_notes: list[str] = []
@@ -568,7 +674,9 @@ def main(argv=None) -> int:
             "verdicts": verdicts,
             "tolerance": args.tolerance,
             "within_run": series_verdicts,
+            "within_run_p99": p99_verdicts,
             "series_tolerance": args.series_tolerance,
+            "p99_tolerance": args.p99_tolerance,
             "skew_tolerance": args.skew_tolerance,
             "northstar": northstar_rows,
             "northstar_notes": northstar_notes,

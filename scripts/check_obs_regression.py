@@ -174,6 +174,24 @@ def _canonical_cache_leg() -> None:
         shutil.rmtree(data_dir, ignore_errors=True)
 
 
+def _canonical_slo_leg() -> None:
+    """Deterministic latency-SLO exercise (see the call site): an
+    explicit spec + synthetic per-batch observations with FIXED walls —
+    the slo.* counter taxonomy (batches / violations / the
+    dominant-stage tag) cannot depend on machine speed."""
+    from photon_tpu.obs import slo
+
+    slo.install("p90<=100ms@60s")
+    # within budget → slo.batches only
+    slo.observe_batch(
+        0.010, {"decode": 0.004, "h2d": 0.003, "readback": 0.002}
+    )
+    # blown budget, decode dominant → slo.violations + .decode tag
+    slo.observe_batch(
+        0.500, {"decode": 0.400, "h2d": 0.050, "readback": 0.040}
+    )
+
+
 def _canonical_fleet_leg(flight_dir: str) -> None:
     """Deterministic fleet-plane exercise (see the call site): no
     threads, no subprocesses, fixed synthetic walls — the counters it
@@ -237,6 +255,11 @@ def collect_snapshot() -> dict:
         # feature-cache knobs: an exported mode/dir/verify flag would
         # change the canonical cache leg's hit/miss/verify counters
         or k.startswith("PHOTON_FEATURE_CACHE")
+        # latency-SLO knobs: an exported spec would arm deadline
+        # tracking during the canonical streaming score and emit
+        # machine-speed-dependent slo.* counters; the canonical SLO leg
+        # below installs its spec explicitly with synthetic walls
+        or k.startswith("PHOTON_SLO_")
         or k
         in (
             "PHOTON_OBS_MEM",
@@ -320,10 +343,20 @@ def collect_snapshot() -> dict:
         # sweep_rows / stragglers counters + the straggler lifecycle
         # instant) into the gated shape.
         _canonical_fleet_leg(flight_dir)
+        # canonical latency-SLO leg: a fixed spec + two synthetic batch
+        # observations (one violating, decode-dominant) — pins the
+        # slo.batches / slo.violations / slo.violations.<stage> counter
+        # taxonomy into the gated shape. Runs AFTER the canonical score
+        # so the real streaming batches above stay un-gated by any SLO
+        # (their walls are machine speed).
+        _canonical_slo_leg()
         SeriesFlusher(
             os.path.join(flight_dir, "series.jsonl"), 60.0
         ).flush_once()
     finally:
+        from photon_tpu.obs import slo as _slo
+
+        _slo.clear()
         obs.disable()
         if flight_dir is not None:
             flight.disable()

@@ -10,8 +10,12 @@ GAME training driver as a subprocess with the HTTP endpoints armed
    text-format parser (``photon_tpu.obs.http.parse_prometheus_text``):
    non-empty, well-formed, and carrying ``photon_*`` families;
 2. GET ``/healthz`` and check the liveness document's shape (status,
-   recovery counters, recorder/flusher liveness);
-3. after the driver exits 0, check the run's ``obs/series.jsonl``
+   recovery counters, recorder/flusher liveness, SLO section);
+3. GET ``/slo`` and check the latency-SLO document: the spec the probe
+   armed via ``PHOTON_SLO_SPEC`` parsed back (percentile/budget/window)
+   plus the burn-rate shape (one entry per window with
+   batches/violations/rate fields);
+4. after the driver exits 0, check the run's ``obs/series.jsonl``
    trajectory has parseable rows and the flight ring closed clean.
 
 Exit 0 = all probes green; non-zero with a named failure otherwise.
@@ -346,6 +350,11 @@ def main() -> int:
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["PHOTON_OBS_HTTP_PORT"] = str(port)
     env["PHOTON_OBS_FLUSH_S"] = "1"
+    # arm a latency SLO so the /slo probe sees a declared spec (the
+    # training driver streams no batches — spec + burn-rate SHAPE is
+    # the contract here; the load harness exercises the live census)
+    slo_spec = "p99<=250ms@60s"
+    env["PHOTON_SLO_SPEC"] = slo_spec
     cmd = [
         sys.executable, "-m", "photon_tpu.cli.game_training",
         *training_args(data_root, out_root),
@@ -404,6 +413,36 @@ def main() -> int:
         print(
             f"[probe] /healthz ok mid-run: status={hz['status']} "
             f"recorder_seq={(hz['recorder'] or {}).get('last_seq')}"
+        )
+        if (hz.get("slo") or {}).get("spec") != slo_spec:
+            raise SystemExit(
+                f"[probe] /healthz slo section missing the armed spec: "
+                f"{hz.get('slo')}"
+            )
+
+        # -- probe 2b: /slo mid-run -----------------------------------
+        sl = json.loads(get(base + "/slo"))
+        if not sl.get("armed"):
+            raise SystemExit(f"[probe] /slo not armed: {sl}")
+        spec_d = sl.get("spec") or {}
+        if spec_d.get("spec") != slo_spec or spec_d.get("percentile") != 99:
+            raise SystemExit(f"[probe] /slo spec mismatch: {spec_d}")
+        burn = sl.get("burn_rates")
+        if not isinstance(burn, dict) or len(burn) != 3:
+            raise SystemExit(f"[probe] /slo burn-rate shape wrong: {burn}")
+        for label, b in burn.items():
+            for key in ("window_s", "batches", "violations", "rate"):
+                if key not in b:
+                    raise SystemExit(
+                        f"[probe] /slo burn window {label} missing "
+                        f"{key!r}: {b}"
+                    )
+        for key in ("violations_by_stage", "waterfall", "e2e"):
+            if key not in sl:
+                raise SystemExit(f"[probe] /slo missing {key!r}")
+        print(
+            f"[probe] /slo ok mid-run: spec={spec_d.get('spec')} "
+            f"burn windows={sorted(burn)}"
         )
 
         # -- driver must still finish clean ---------------------------

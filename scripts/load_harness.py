@@ -1,0 +1,372 @@
+#!/usr/bin/env python
+"""Open-loop Poisson load harness for the streaming scorer.
+
+The serving roadmap item's production metric is TAIL latency under a
+Poisson arrival process — the number a bulk samples/sec bench cannot
+see. This harness supplies it: a synthetic CTR-shape GAME model (FE +
+per-user RE + user×item MF, the bench config-6 shape) scored through
+the real ``GameScorer.stream`` pipeline while requests (one
+``batch_rows`` micro-batch each) arrive on a seeded Poisson schedule,
+and the report is the sustained-QPS vs tail-latency curve:
+p50/p90/p99/p99.9 end-to-end per offered rate, violation census by
+dominant stage, and the exported ``slo_report.json``.
+
+**Open loop / no coordinated omission.** Arrival times are drawn up
+front (cumulative exponential inter-arrivals, seeded) and are NEVER
+deferred by completions: each request is stamped with its SCHEDULED
+arrival (``chunk.slo_arrival_t``, the scorer's birth timebase), so when
+the pipeline backs up, the backlog wait is charged to the request as
+its ``queue`` stage instead of silently stretching the arrival process
+— the closed-loop lie that makes overloaded systems look healthy.
+Admission is bounded (the scorer's constant-residency staging), but the
+latency CLOCK always starts at the scheduled arrival.
+
+Legs run coldest-first: an unthrottled calibration pass measures the
+pipeline's capacity (requests/sec with zero pacing), then each
+``--qps`` leg (or ``auto``: 0.5× and 0.8× of measured capacity) runs
+with a fresh registry. The SLO gate (:func:`photon_tpu.obs.slo.
+check_slo`) judges every paced leg; exit codes mirror
+``scripts/bench_trend.py``: 0 healthy, 3 = a leg breached the armed
+SLO (the failure names the dominant stage — inject a per-stage stall
+via ``PHOTON_FAULTS`` to see it flip).
+
+Usage::
+
+    python scripts/load_harness.py --qps 40 --requests 32 \\
+        --spec 'p99<=1s@60s' --out load_harness_out
+    PHOTON_FAULTS='scoring.chunk@*=stall:0.3' \\
+        python scripts/load_harness.py --qps 20 --spec 'p99<=100ms@60s'
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def build_workload(
+    num_requests: int = 32,
+    batch_rows: int = 256,
+    d: int = 16,
+    nnz: int = 8,
+    users: int = 64,
+    items: int = 16,
+    mf_factors: int = 4,
+    seed: int = 0,
+):
+    """A CTR-shape scorer + pre-sliced request chunks, all in memory
+    (the harness measures SERVING latency; decode-wall scenarios inject
+    at the ``scoring.chunk`` fault point, which fires per request
+    regardless of the chunk source). Returns ``(scorer, chunks)``."""
+    import numpy as np
+
+    from photon_tpu.game.data import CSRMatrix, GameData, slice_game_data
+    from photon_tpu.game.model import (
+        BucketCoefficients,
+        FixedEffectModel,
+        GameModel,
+        MatrixFactorizationModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.game.scoring import GameScorer
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.glm import model_for_task
+    from photon_tpu.types import TaskType
+
+    import jax.numpy as jnp
+
+    n = num_requests * batch_rows
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, users, size=n)
+    item_ids = rng.integers(0, items, size=n)
+    cols = np.sort(np.argsort(rng.random((n, d)), axis=1)[:, :nnz], axis=1)
+    vals = rng.normal(size=(n, nnz)) / np.sqrt(nnz)
+    w_fe = rng.normal(size=d) * 0.5
+    w_re = rng.normal(size=(users, d)) * 0.5
+    uf = rng.normal(size=(users, mf_factors)) * 0.3
+    vf = rng.normal(size=(items, mf_factors)) * 0.3
+
+    indptr = np.arange(n + 1, dtype=np.int64) * nnz
+    shard = CSRMatrix(
+        indptr=indptr,
+        indices=cols.reshape(-1).astype(np.int32),
+        values=vals.reshape(-1).astype(np.float64),
+        num_cols=d,
+    )
+    data = GameData.build(
+        labels=np.zeros(n),
+        feature_shards={"global": shard},
+        id_tags={
+            "userId": [f"u{int(i)}" for i in ids],
+            "itemId": [f"it{int(i)}" for i in item_ids],
+        },
+    )
+
+    task = TaskType.LOGISTIC_REGRESSION
+    vocab = np.array(sorted(f"u{i}" for i in range(users)))
+    model = GameModel(
+        coordinates={
+            "fixed": FixedEffectModel(
+                model=model_for_task(
+                    task, Coefficients(means=jnp.asarray(w_fe))
+                ),
+                feature_shard="global",
+            ),
+            "per-user": RandomEffectModel(
+                random_effect_type="userId",
+                feature_shard="global",
+                task=task,
+                vocab=vocab,
+                buckets=(
+                    BucketCoefficients(
+                        entity_ids=np.arange(users, dtype=np.int64),
+                        col_index=np.tile(
+                            np.arange(d, dtype=np.int64), (users, 1)
+                        ),
+                        coefficients=w_re[[int(k[1:]) for k in vocab]],
+                    ),
+                ),
+                num_features=d,
+            ),
+            "mf": MatrixFactorizationModel(
+                row_entity_type="userId",
+                col_entity_type="itemId",
+                row_vocab=np.array([f"u{i}" for i in range(users)]),
+                col_vocab=np.array([f"it{i}" for i in range(items)]),
+                row_factors=uf,
+                col_factors=vf,
+            ),
+        },
+        task=task,
+    )
+    scorer = GameScorer(model, batch_rows=batch_rows)
+    scorer.precompile(ell_widths={"global": nnz})
+    chunks = [
+        slice_game_data(data, lo, lo + batch_rows)
+        for lo in range(0, n, batch_rows)
+    ]
+    return scorer, chunks
+
+
+def poisson_schedule(qps: float, num: int, seed: int):
+    """Cumulative arrival offsets (seconds from leg start): seeded
+    exponential inter-arrivals at rate ``qps``."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / qps, size=num))
+
+
+def drive(scorer, chunks, arrivals=None):
+    """One leg through the real streaming pipeline. ``arrivals`` is the
+    per-request scheduled offset array (None = unthrottled calibration).
+    The generator sleeps until each scheduled arrival and stamps the
+    request with it — even when the stamp is already in the past
+    (pipeline backed up), which is exactly when the stamp matters."""
+    t0 = time.perf_counter()
+
+    def gen():
+        for i, chunk in enumerate(chunks):
+            if arrivals is not None:
+                target = t0 + float(arrivals[i])
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                chunk.slo_arrival_t = target
+            elif hasattr(chunk, "slo_arrival_t"):
+                del chunk.slo_arrival_t  # calibration: decode-start birth
+            yield chunk
+
+    result = scorer.stream(gen(), collect_scores=False)
+    return result, time.perf_counter() - t0
+
+
+def run_leg(scorer, chunks, qps: float, seed: int) -> dict:
+    """One paced leg: Poisson arrivals at ``qps``, end-to-end latency
+    percentiles (queueing included), violation census."""
+    arrivals = poisson_schedule(qps, len(chunks), seed)
+    result, wall = drive(scorer, chunks, arrivals)
+    st = result.stats
+    return {
+        "offered_qps": round(qps, 3),
+        "requests": st.batches,
+        "samples": st.samples,
+        "wall_s": round(wall, 4),
+        "achieved_qps": round(st.batches / wall, 3),
+        "samples_per_sec": round(st.samples / wall, 1),
+        "latency_s": st.e2e_percentiles(),
+        "stage_p99_s": {
+            k: v["p99"] for k, v in st.stage_percentiles().items()
+        },
+        "violations": st.deadline_violations,
+        "violations_by_stage": dict(st.violations_by_stage),
+        "batch_retries": st.batch_retries,
+    }
+
+
+def run_load(
+    qps_list,
+    *,
+    num_requests: int = 32,
+    batch_rows: int = 256,
+    spec: str = "p99<=1s@60s",
+    seed: int = 0,
+    out_dir: str | None = None,
+    prefix: str = "",
+    workload_kwargs: dict | None = None,
+) -> dict:
+    """The whole harness as a library call (bench's tail-latency config
+    drives it in-process): calibrate capacity unthrottled, run each
+    paced leg against the armed SLO, gate every leg, export artifacts
+    for the LAST leg under ``out_dir``. Returns the curve document."""
+    from photon_tpu import obs
+    from photon_tpu.obs import slo
+
+    scorer, chunks = build_workload(
+        num_requests=num_requests,
+        batch_rows=batch_rows,
+        seed=seed,
+        **(workload_kwargs or {}),
+    )
+    obs.reset()
+    obs.enable()
+    tracker = slo.install(spec)
+    try:
+        # unthrottled calibration: pipeline capacity in requests/sec —
+        # the denominator that makes "auto" offered rates meaningful
+        # (its batches DO feed the tracker; the per-leg obs.reset below
+        # clears them before the first paced leg)
+        cal_result, cal_wall = drive(scorer, chunks)
+        capacity_qps = cal_result.stats.batches / cal_wall
+        if qps_list == "auto":
+            qps_list = [0.5 * capacity_qps, 0.8 * capacity_qps]
+        legs = []
+        for i, qps in enumerate(qps_list):
+            obs.reset()  # fresh registry + SLO census per leg (spec stays)
+            leg = run_leg(scorer, chunks, float(qps), seed + i)
+            report = slo.report()
+            # same burn tolerance as the offline CLI gate: the
+            # PHOTON_SLO_GATE_BURN knob must mean one thing everywhere
+            leg["slo_violations"] = slo.check_slo(
+                report, max_burn=slo.gate_max_burn()
+            )
+            leg["gate_ok"] = not leg["slo_violations"]
+            leg["burn_rates"] = report.get("burn_rates")
+            legs.append(leg)
+        paths = {}
+        if out_dir is not None:
+            # exported while the tracker is still armed, so the
+            # slo_report.json carries the spec + the final leg's census
+            paths = obs.export_artifacts(
+                out_dir,
+                prefix=prefix,
+                meta={
+                    "harness": "load_harness",
+                    "spec": tracker.spec.render(),
+                },
+            )
+        return {
+            "spec": tracker.spec.as_dict(),
+            "num_requests": num_requests,
+            "batch_rows": batch_rows,
+            "seed": seed,
+            "capacity_qps": round(capacity_qps, 3),
+            "calibration_wall_s": round(cal_wall, 4),
+            "legs": legs,
+            "gate_ok": all(leg["gate_ok"] for leg in legs),
+            "artifacts": paths,
+        }
+    finally:
+        obs.disable()
+        slo.clear()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--qps",
+        default="auto",
+        help="comma-separated offered rates (requests/sec), or 'auto' "
+        "for 0.5x and 0.8x of the measured unthrottled capacity",
+    )
+    ap.add_argument(
+        "--requests", type=int, default=32, help="requests per leg"
+    )
+    ap.add_argument(
+        "--batch-rows", type=int, default=256, help="rows per request"
+    )
+    ap.add_argument(
+        "--spec",
+        default="p99<=1s@60s",
+        help="the SLO to arm (PHOTON_SLO_SPEC-format, e.g. p99<=50ms@60s)",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--out",
+        default="load_harness_out",
+        help="artifact directory (slo_report.json + trace/metrics land "
+        "here); report JSON is written as load_harness_report.json",
+    )
+    ap.add_argument(
+        "--no-gate",
+        action="store_true",
+        help="report only: do not exit 3 on SLO breach",
+    )
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from photon_tpu.util import faults
+
+    faults.install_from_env()  # PHOTON_FAULTS drives the stall scenarios
+
+    qps_list = (
+        "auto"
+        if args.qps.strip() == "auto"
+        else [float(q) for q in args.qps.split(",") if q.strip()]
+    )
+    doc = run_load(
+        qps_list,
+        num_requests=args.requests,
+        batch_rows=args.batch_rows,
+        spec=args.spec,
+        seed=args.seed,
+        out_dir=args.out,
+    )
+    os.makedirs(args.out, exist_ok=True)
+    report_path = os.path.join(args.out, "load_harness_report.json")
+    with open(report_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+
+    print(
+        f"capacity {doc['capacity_qps']} req/s "
+        f"(spec {doc['spec']['spec']})"
+    )
+    for leg in doc["legs"]:
+        lat = leg["latency_s"]
+        marker = "ok" if leg["gate_ok"] else "FAIL"
+        print(
+            f"[{marker}] offered {leg['offered_qps']} req/s → achieved "
+            f"{leg['achieved_qps']} req/s; e2e p50={lat.get('p50')}s "
+            f"p90={lat.get('p90')}s p99={lat.get('p99')}s "
+            f"p99.9={lat.get('p99.9')}s; "
+            f"violations={leg['violations']} {leg['violations_by_stage']}"
+        )
+        for v in leg["slo_violations"]:
+            print(f"       {v}")
+    print(f"report: {report_path}")
+    if not doc["gate_ok"] and not args.no_gate:
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -406,6 +406,18 @@ def test_scoring_driver_sharded_streaming_output(
     assert summary["scoring"]["batchRows"] == 64
     assert summary["scoring"]["numOutputPartitions"] == 2
     assert summary["scoring"]["batches"] == 4
+    # the per-stage latency waterfall (ISSUE 15): p50/p90/p99 per
+    # pipeline stage + end-to-end percentiles incl. p99.9 — not only
+    # the aggregate batch latency
+    waterfall = summary["scoring"]["stageLatency"]
+    assert {"decode", "assemble", "h2d", "dispatch", "pipeline",
+            "readback", "write"} <= set(waterfall)
+    for stage, pcts in waterfall.items():
+        assert set(pcts) == {"p50", "p90", "p99"}, stage
+        assert pcts["p50"] <= pcts["p99"]
+    e2e = summary["scoring"]["e2eLatency"]
+    assert {"p50", "p90", "p99", "p99.9"} <= set(e2e)
+    assert summary["scoring"]["slo"] is None  # no spec armed
 
     # the escape hatch still produces the single-part monolithic layout
     mono_out = tmp_path / "scoring-mono"

@@ -114,7 +114,7 @@ def test_histogram_quantile_lines_match_registry_percentiles():
     by_label = {
         lab.get("quantile"): v for name, lab, v in samples if lab
     }
-    assert set(by_label) == {"0.5", "0.9", "0.99"}
+    assert set(by_label) == {"0.5", "0.9", "0.99", "0.999"}
     for q, v in by_label.items():
         assert v == pytest.approx(
             reg.percentile("score.batch_seconds", 100 * float(q))

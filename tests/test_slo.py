@@ -1,0 +1,748 @@
+"""Latency SLO plane (ISSUE 15): spec parsing, burn rates, the
+per-batch lifecycle in GameScorer.stream, dominant-stage attribution
+under injected stalls, the check_slo gate's exit codes, histogram tail
+fidelity (within-bucket interpolation + p99.9), the /slo endpoint, and
+the Poisson load harness."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_tpu import obs
+from photon_tpu.obs import slo
+from photon_tpu.obs.metrics import MetricsRegistry, percentile_from_buckets
+from photon_tpu.util import faults
+
+SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo(monkeypatch):
+    monkeypatch.delenv("PHOTON_SLO_SPEC", raising=False)
+    monkeypatch.delenv("PHOTON_SLO_GATE_BURN", raising=False)
+    slo.clear()
+    obs.reset()
+    obs.disable()
+    faults.clear()
+    yield
+    faults.clear()
+    slo.clear()
+    obs.reset()
+    obs.disable()
+
+
+# -- spec -------------------------------------------------------------------
+
+
+def test_spec_parse_render_roundtrip():
+    s = slo.SloSpec.parse("p99<=50ms@60s")
+    assert s.percentile == 99.0
+    assert s.budget_s == pytest.approx(0.05)
+    assert s.window_s == 60.0
+    assert s.error_budget == pytest.approx(0.01)
+    assert s.render() == "p99<=50ms@60s"
+    assert slo.SloSpec.parse(s.render()) == s
+
+    s2 = slo.SloSpec.parse("p99.9 <= 0.2s @ 120s")
+    assert s2.percentile == 99.9
+    assert s2.budget_s == pytest.approx(0.2)
+    assert slo.SloSpec.parse(s2.render()) == s2
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["", "p99<50ms@60s", "99<=50ms@60s", "p99<=50m@60s", "p99<=50ms",
+     "p0<=50ms@60s", "p100<=50ms@60s", "p99<=0ms@60s", "p99<=50ms@0s"],
+)
+def test_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        slo.SloSpec.parse(bad)
+
+
+def test_spec_from_env(monkeypatch):
+    assert slo.spec_from_env() is None
+    monkeypatch.setenv("PHOTON_SLO_SPEC", "p95<=200ms@30s")
+    s = slo.spec_from_env()
+    assert s.percentile == 95.0 and s.budget_s == pytest.approx(0.2)
+    monkeypatch.setenv("PHOTON_SLO_SPEC", "nonsense")
+    with pytest.raises(ValueError):
+        slo.spec_from_env()
+
+
+def test_ensure_from_env_arms_once_and_programmatic_wins(monkeypatch):
+    monkeypatch.setenv("PHOTON_SLO_SPEC", "p99<=1s@60s")
+    t = slo.ensure_from_env()
+    assert t is not None and t.spec.percentile == 99.0
+    assert slo.ensure_from_env() is t  # idempotent
+    explicit = slo.install("p90<=2s@60s")
+    assert slo.ensure_from_env() is explicit  # install wins over env
+
+
+def test_gate_max_burn_env_wins(monkeypatch):
+    assert slo.gate_max_burn() == 1.0
+    assert slo.gate_max_burn(2.5) == 2.5
+    monkeypatch.setenv("PHOTON_SLO_GATE_BURN", "4.0")
+    assert slo.gate_max_burn(2.5) == 4.0
+    monkeypatch.setenv("PHOTON_SLO_GATE_BURN", "-1")
+    with pytest.raises(ValueError):
+        slo.gate_max_burn()
+
+
+# -- tracker ----------------------------------------------------------------
+
+
+def test_tracker_violations_and_dominant_stage():
+    t = slo.install("p90<=100ms@60s")
+    assert slo.observe_batch(0.01, {"decode": 0.005, "h2d": 0.004}) is None
+    assert (
+        slo.observe_batch(0.5, {"decode": 0.40, "h2d": 0.05}) == "decode"
+    )
+    assert slo.observe_batch(0.3, {"queue": 0.2, "h2d": 0.05}) == "queue"
+    assert t.batches == 3
+    assert t.violations == 2
+    assert t.by_stage == {"decode": 1, "queue": 1}
+    # non-finite latency is always a violation, attribution survives
+    assert slo.observe_batch(float("nan"), {"h2d": 1.0}) == "h2d"
+    # no stage breakdown → the violation still counts, unattributed
+    assert slo.observe_batch(9.9, None) == "unattributed"
+
+
+def test_burn_rates_windows_and_values():
+    t = slo.install("p99<=10ms@60s")  # error budget 1%
+    for _ in range(99):
+        t.observe(0.001, {"h2d": 0.001})
+    t.observe(1.0, {"h2d": 1.0})  # 1/100 violating = exactly budget
+    rates = t.burn_rates()
+    assert sorted(b["window_s"] for b in rates.values()) == sorted(
+        [60.0, 10.0, 60.0 / 36]
+    )
+    long = rates["60s"]
+    assert long["batches"] == 100 and long["violations"] == 1
+    assert long["rate"] == pytest.approx(1.0, rel=1e-6)
+    # a window that saw no batches reports rate None
+    t2 = slo.install("p99<=10ms@60s")
+    assert all(b["rate"] is None for b in t2.burn_rates().values())
+
+
+def test_observe_batch_noop_when_disarmed():
+    assert slo.observe_batch(100.0, {"h2d": 100.0}) is None
+    obs.enable()
+    assert slo.observe_batch(100.0, {"h2d": 100.0}) is None
+    assert "slo.batches" not in obs.get_registry().snapshot()["counters"]
+
+
+def test_slo_counters_flow_through_gated_pipeline():
+    slo.install("p90<=1ms@60s")
+    obs.enable()
+    slo.observe_batch(0.5, {"decode": 0.4, "h2d": 0.1})
+    slo.observe_batch(0.0005, {"decode": 0.0004})
+    counters = obs.get_registry().snapshot()["counters"]
+    assert counters["slo.batches"] == 2
+    assert counters["slo.violations"] == 1
+    assert counters["slo.violations.decode"] == 1
+    # obs.reset clears the census but keeps the spec armed
+    obs.reset()
+    t = slo.active()
+    assert t is not None and t.batches == 0 and t.violations == 0
+
+
+# -- histogram tail fidelity (satellite) ------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,values",
+    [
+        (
+            # 40/60 split so no tested quantile sits in the inter-mode
+            # density gap, where ANY histogram read is ill-defined
+            "bimodal",
+            np.concatenate(
+                [
+                    np.random.default_rng(3).normal(0.01, 0.001, 4000),
+                    np.random.default_rng(4).normal(0.5, 0.05, 6000),
+                ]
+            ),
+        ),
+        (
+            "heavy_tail",
+            np.random.default_rng(5).lognormal(-4.0, 1.5, 20000),
+        ),
+        (
+            "pareto_tail",
+            0.001 * (1 + np.random.default_rng(6).pareto(1.5, 20000)),
+        ),
+    ],
+)
+def test_bucket_quantiles_track_numpy_on_adversarial_samples(name, values):
+    """Satellite: sparse-bucket quantiles (with within-bucket
+    interpolation) vs exact numpy quantiles on bimodal and heavy-tail
+    samples — within the ×1.1 bucket's documented ~±5% relative
+    resolution, p99.9 included."""
+    values = np.abs(values)
+    reg = MetricsRegistry()
+    for v in values:
+        reg.histogram("lat", float(v))
+    for q in (50, 90, 99, 99.9):
+        exact = float(np.percentile(values, q))
+        got = reg.percentile("lat", q)
+        assert got is not None
+        assert abs(got - exact) / exact < 0.06, (name, q, got, exact)
+
+
+def test_snapshot_carries_p999_summary():
+    reg = MetricsRegistry()
+    for i in range(2000):
+        reg.histogram("lat", 0.001 * (i + 1))
+    h = reg.snapshot()["histograms"]["lat"]
+    assert "p99.9" in h
+    assert h["p99.9"] == reg.percentile("lat", 99.9)
+    assert h["p50"] <= h["p90"] <= h["p99"] <= h["p99.9"]
+
+
+def test_interpolation_resolves_within_a_dense_bucket():
+    """All mass in ONE bucket: the midpoint-only read returned a single
+    value for every q; interpolation must spread ranks across the
+    bucket monotonically while staying inside the observed range."""
+    reg = MetricsRegistry()
+    for _ in range(1000):
+        reg.histogram("x", 1.0)  # one bucket
+    assert reg.percentile("x", 50) == pytest.approx(1.0, rel=0.05)
+    h = {"count": 4, "min": 1.0, "max": 1.09, "buckets": {"0": 4}}
+    qs = [percentile_from_buckets(h, q) for q in (10, 50, 90)]
+    assert qs == sorted(qs)
+    assert all(1.0 <= v <= 1.09 for v in qs)
+
+
+def test_outlier_buckets_keep_prior_semantics():
+    reg = MetricsRegistry()
+    reg.histogram("x", float("nan"))
+    reg.histogram("x", 0.0)
+    assert reg.percentile("x", 10) == 0.0  # floor bucket
+    assert reg.percentile("x", 99) is not None  # ceiling renders
+
+
+# -- scorer lifecycle -------------------------------------------------------
+
+
+def _tiny_scorer(n=256, d=8, batch_rows=64, seed=0):
+    import jax.numpy as jnp
+
+    from photon_tpu.game.data import CSRMatrix, GameData
+    from photon_tpu.game.model import FixedEffectModel, GameModel
+    from photon_tpu.game.scoring import GameScorer
+    from photon_tpu.models.coefficients import Coefficients
+    from photon_tpu.models.glm import model_for_task
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    data = GameData.build(
+        labels=np.zeros(n),
+        feature_shards={"g": CSRMatrix.from_dense(x)},
+        id_tags={},
+    )
+    task = TaskType.LINEAR_REGRESSION
+    model = GameModel(
+        coordinates={
+            "fixed": FixedEffectModel(
+                model=model_for_task(
+                    task, Coefficients(means=jnp.asarray(w))
+                ),
+                feature_shard="g",
+            )
+        },
+        task=task,
+    )
+    return GameScorer(model, batch_rows=batch_rows), data, x @ w
+
+
+def _chunks(data, batch_rows):
+    from photon_tpu.game.data import slice_game_data
+
+    n = data.num_samples
+    return [
+        slice_game_data(data, lo, min(lo + batch_rows, n))
+        for lo in range(0, n, batch_rows)
+    ]
+
+
+def test_stream_records_stage_walls_and_e2e():
+    scorer, data, expected = _tiny_scorer()
+    res = scorer.stream(iter(_chunks(data, 64)))
+    np.testing.assert_allclose(res.scores, expected, rtol=1e-4)
+    st = res.stats
+    assert len(st.e2e_walls_s) == st.batches == 4
+    for stage in ("decode", "queue", "assemble", "h2d", "dispatch",
+                  "pipeline", "readback"):
+        assert len(st.stage_walls_s[stage]) == 4, stage
+        assert all(w >= 0 for w in st.stage_walls_s[stage])
+    # no sink → no write stage
+    assert "write" not in st.stage_walls_s
+    p = st.e2e_percentiles()
+    assert set(p) >= {"p50", "p90", "p99", "p99.9", "mean", "max"}
+    assert p["p50"] <= p["p99.9"] <= p["max"]
+    waterfall = st.stage_percentiles()
+    assert set(waterfall) == set(st.stage_walls_s)
+    assert all(
+        v["p50"] <= v["p99"] for v in waterfall.values()
+    )
+    # e2e covers the measured stages for each batch
+    assert st.deadline_violations == 0  # no SLO armed
+
+
+def test_stream_write_stage_recorded_with_sink():
+    scorer, data, _ = _tiny_scorer()
+    seen = []
+    res = scorer.stream(
+        iter(_chunks(data, 64)), on_batch=lambda c, s: seen.append(len(s))
+    )
+    assert sum(seen) == data.num_samples
+    assert len(res.stats.stage_walls_s["write"]) == res.stats.batches
+
+
+def test_stream_emits_stage_histograms_when_enabled():
+    scorer, data, _ = _tiny_scorer()
+    obs.enable()
+    scorer.stream(iter(_chunks(data, 64)))
+    hists = obs.get_registry().snapshot()["histograms"]
+    assert hists["score.e2e_seconds"]["count"] == 4
+    for stage in ("decode", "queue", "assemble", "h2d", "dispatch",
+                  "pipeline", "readback"):
+        assert hists[f"score.stage_seconds.{stage}"]["count"] == 4, stage
+
+
+def test_arrival_stamp_charges_queueing_to_the_batch():
+    """Open-loop accounting: a chunk stamped with a PAST scheduled
+    arrival must report e2e latency that includes the backlog wait —
+    the coordinated-omission contract."""
+    import time
+
+    scorer, data, _ = _tiny_scorer(n=64, batch_rows=64)
+    chunk = _chunks(data, 64)[0]
+    chunk.slo_arrival_t = time.perf_counter() - 0.5  # born 500ms ago
+    res = scorer.stream(iter([chunk]))
+    assert res.stats.e2e_walls_s[0] >= 0.5
+    # the pacing wait is NOT charged to decode (it clips to post-birth)
+    assert res.stats.stage_walls_s["decode"][0] < 0.5
+
+
+def test_deadline_violation_counted_against_armed_slo():
+    scorer, data, _ = _tiny_scorer(n=128, batch_rows=64)
+    slo.install("p99<=1ms@60s")  # everything violates
+    obs.enable()
+    res = scorer.stream(iter(_chunks(data, 64)))
+    st = res.stats
+    assert st.deadline_violations == st.batches == 2
+    assert sum(st.violations_by_stage.values()) == 2
+    counters = obs.get_registry().snapshot()["counters"]
+    assert counters["slo.batches"] == 2
+    assert counters["slo.violations"] == 2
+
+
+# -- injected per-stage stalls name the dominant stage (acceptance) ---------
+
+
+def test_decode_stall_attributed_and_gate_flips(tmp_path):
+    """The acceptance pin: an injected decode-side stall (PR 10 fault
+    point scoring.chunk) blows the deadline, the violation names decode
+    as dominant, and the check_slo gate exits with its violation code
+    (3, mirroring bench_trend) off the exported slo_report.json. A
+    single-batch stream makes the dominant stage deterministic (no
+    double-buffer hold of a neighboring stalled batch to tie with)."""
+    scorer, data, _ = _tiny_scorer(n=64, batch_rows=64)
+    scorer.stream(iter(_chunks(data, 64)))  # warm: compiles paid here
+    slo.install("p90<=50ms@60s")
+    obs.enable()
+    with faults.injected("scoring.chunk@1=stall:0.3"):
+        res = scorer.stream(iter(_chunks(data, 64)))
+    st = res.stats
+    assert st.deadline_violations == 1
+    assert st.violations_by_stage == {"decode": 1}
+    report = slo.report()
+    assert report["violations_by_stage"] == {"decode": 1}
+    assert report["dominant_stage"] == "decode"
+    assert report["objective"]["ok"] is False
+    violations = slo.check_slo(report)
+    assert violations and any("decode" in v for v in violations)
+    # the exported artifact drives the CLI gate to the violation exit
+    paths = obs.export_artifacts(tmp_path)
+    assert os.path.basename(paths["slo"]) == "slo_report.json"
+    assert slo.main([paths["slo"]]) == 3
+    doc = json.load(open(paths["slo"]))
+    assert doc["slo"]["violations_by_stage"]["decode"] == 1
+
+
+def test_dispatch_stall_attributed_to_dispatch():
+    """A stall on the batch path (fault point scoring.batch fires
+    before H2D inside the retried thunk) charges the dispatch stage."""
+    scorer, data, _ = _tiny_scorer(n=64, batch_rows=64)
+    scorer.stream(iter(_chunks(data, 64)))  # warm: compiles paid here
+    slo.install("p90<=50ms@60s")
+    obs.enable()
+    with faults.injected("scoring.batch@1=stall:0.3"):
+        res = scorer.stream(iter(_chunks(data, 64)))
+    assert res.stats.violations_by_stage == {"dispatch": 1}
+    assert slo.report()["dominant_stage"] == "dispatch"
+
+
+def test_mid_stream_stall_delays_neighbor_via_pipeline_hold():
+    """Multi-batch attribution honesty: a mid-stream decode stall also
+    delays the PREVIOUS batch's deferred read-back — that wall is
+    charged to the explicit ``pipeline`` stage, never silently to
+    h2d/readback. The stalled batch itself still names decode."""
+    scorer, data, _ = _tiny_scorer(n=192, batch_rows=64)
+    scorer.stream(iter(_chunks(data, 64)))  # warm
+    slo.install("p90<=50ms@60s")
+    obs.enable()
+    with faults.injected("scoring.chunk@2=stall:0.3"):
+        res = scorer.stream(iter(_chunks(data, 64)))
+    by_stage = res.stats.violations_by_stage
+    assert by_stage.get("decode", 0) >= 1
+    assert set(by_stage) <= {"decode", "pipeline"}
+
+
+def test_healthy_stream_passes_gate(tmp_path):
+    scorer, data, _ = _tiny_scorer(n=128, batch_rows=64)
+    slo.install("p99<=30s@60s")
+    obs.enable()
+    scorer.stream(iter(_chunks(data, 64)))
+    report = slo.report()
+    assert report["objective"]["ok"] is True
+    assert slo.check_slo(report) == []
+    paths = obs.export_artifacts(tmp_path)
+    assert slo.main([paths["slo"]]) == 0
+
+
+def test_check_slo_disarmed_report_fails_loudly():
+    violations = slo.check_slo({"armed": False, "spec": None})
+    assert violations and "no SLO spec armed" in violations[0]
+    assert slo.main(["/nonexistent/slo.json"]) == 3
+
+
+# -- report / export / endpoint ---------------------------------------------
+
+
+def test_report_without_tracker_or_batches_not_reportable():
+    doc = slo.report()
+    assert doc["armed"] is False and doc["observed"] is False
+    assert not slo.reportable(doc)
+
+
+def test_export_skips_slo_report_when_nothing_to_say(tmp_path):
+    paths = obs.export_artifacts(tmp_path)
+    assert "slo" not in paths
+    assert not (tmp_path / "slo_report.json").exists()
+
+
+def test_export_writes_slo_report_when_armed(tmp_path):
+    slo.install("p99<=50ms@60s")
+    paths = obs.export_artifacts(tmp_path)
+    doc = json.load(open(paths["slo"]))
+    assert doc["slo"]["armed"] is True
+    assert doc["slo"]["spec"]["spec"] == "p99<=50ms@60s"
+    assert "burn_rates" in doc["slo"]
+
+
+def test_slo_endpoint_and_healthz_section():
+    from photon_tpu.obs.http import TelemetryServer
+
+    slo.install("p90<=100ms@60s")
+    obs.enable()
+    slo.observe_batch(0.5, {"decode": 0.4, "h2d": 0.1})
+    srv = TelemetryServer(0)
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/slo", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["armed"] is True
+        assert doc["spec"]["spec"] == "p90<=100ms@60s"
+        assert doc["violations"] == 1
+        assert doc["violations_by_stage"] == {"decode": 1}
+        assert len(doc["burn_rates"]) == 3
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            hz = json.loads(resp.read())
+        assert hz["slo"]["spec"] == "p90<=100ms@60s"
+        assert hz["slo"]["status"] == "violating"
+        assert hz["slo"]["violations"] == 1
+    finally:
+        srv.stop()
+
+
+def test_healthz_slo_unarmed():
+    from photon_tpu.obs.http import healthz_snapshot
+
+    doc = healthz_snapshot()
+    assert doc["slo"] == {"status": "unarmed", "spec": None}
+
+
+# -- burn rates from series rows --------------------------------------------
+
+
+def test_burn_rates_from_series_windows():
+    spec = slo.SloSpec.parse("p99<=10ms@60s")  # windows 60/10/1.67
+    rows = [
+        {"interval_s": 10.0, "counters": {"slo.batches": 100}},
+        {"interval_s": 10.0,
+         "counters": {"slo.batches": 100, "slo.violations": 50}},
+    ]
+    out = slo.burn_rates_from_series(rows, spec)
+    # the 60s window spans both rows: 50/200 violating / 1% budget
+    assert out["60s"]["batches"] == 200
+    assert out["60s"]["rate"] == pytest.approx(25.0)
+    # the 10s window covers only the trailing row: 50/100 / 1%
+    assert out["10s"]["batches"] == 100
+    assert out["10s"]["rate"] == pytest.approx(50.0)
+
+
+def test_check_slo_series_burn_gate():
+    slo.install("p99<=10ms@60s")
+    doc = slo.report()
+    doc["observed"] = True
+    rows = [
+        {"interval_s": 5.0,
+         "counters": {"slo.batches": 10, "slo.violations": 10}},
+    ]
+    violations = slo.check_slo(doc, max_burn=1.0, series_rows=rows)
+    assert any("series burn rate" in v for v in violations)
+
+
+def test_healthz_slo_violating_after_breach_ages_out_of_windows():
+    """A breach whose events aged out of every burn window (all rates
+    None) must still read 'violating' — nothing observed since says it
+    recovered (the documented contract)."""
+    from photon_tpu.obs.http import slo_health_section
+
+    tracker = slo.install("p99<=1ms@60s")
+    tracker.observe(5.0, {"decode": 5.0})
+    tracker._events.clear()  # simulate the events aging out
+    doc = slo_health_section()
+    assert all(b["rate"] is None for b in doc["burn_rates"].values())
+    assert doc["status"] == "violating"
+
+
+def test_series_rows_carry_per_interval_percentiles():
+    """The flusher's histogram percentiles are PER-INTERVAL (bucket
+    deltas), not the cumulative registry state — a tail that degrades
+    late in a run must show in the late rows, which is what the
+    bench_trend --p99-tolerance gate reads."""
+    from photon_tpu.obs.series import SeriesFlusher
+
+    import tempfile
+
+    reg = MetricsRegistry()
+    path = os.path.join(tempfile.mkdtemp(prefix="slo-series-"), "s.jsonl")
+    f = SeriesFlusher(path, 60.0, registry=reg)
+    for _ in range(500):
+        reg.histogram("score.e2e_seconds", 0.01)
+    row1 = f.flush_once()
+    assert row1["histograms"]["score.e2e_seconds"]["p99"] == pytest.approx(
+        0.01, rel=0.06
+    )
+    for _ in range(50):
+        reg.histogram("score.e2e_seconds", 1.0)  # the tail degrades
+    row2 = f.flush_once()
+    h2 = row2["histograms"]["score.e2e_seconds"]
+    assert h2["count"] == 50
+    # cumulative p99 would read ~0.01 (50/550 over budget); the
+    # interval p99 must read the degraded ~1.0 (one full ×1.1 bucket
+    # width of slack: interval reads have no min/max to clamp into)
+    assert h2["p99"] == pytest.approx(1.0, rel=0.11)
+    # an interval where the histogram never moved reports None
+    row3 = f.flush_once()
+    assert row3["histograms"]["score.e2e_seconds"]["count"] == 0
+    assert row3["histograms"]["score.e2e_seconds"]["p99"] is None
+
+
+# -- bench_trend p99 series gate --------------------------------------------
+
+
+def _write_series(path, p99s):
+    with open(path, "w") as f:
+        for i, p in enumerate(p99s):
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "series",
+                        "row": i,
+                        "t_s": float(i),
+                        "interval_s": 1.0,
+                        "counters": {"score.samples": 100},
+                        "gauges": {},
+                        "histograms": {
+                            "score.e2e_seconds": {
+                                "count": 10,
+                                "p50": p / 2,
+                                "p90": p * 0.9,
+                                "p99": p,
+                                "p99.9": p * 1.1,
+                            }
+                        },
+                    }
+                )
+                + "\n"
+            )
+
+
+def test_bench_trend_p99_gate_fails_on_tail_creep(tmp_path):
+    import bench_trend
+
+    creeping = tmp_path / "creep.series.jsonl"
+    _write_series(creeping, [0.01, 0.012, 0.011, 0.05])
+    v = bench_trend.judge_series_p99(str(creeping), "score.e2e_seconds", 3.0)
+    assert v["status"] == "fail"
+    assert "tail creep" in v["notes"][0]
+
+    flat = tmp_path / "flat.series.jsonl"
+    _write_series(flat, [0.01, 0.011, 0.0105, 0.0102])
+    v = bench_trend.judge_series_p99(str(flat), "score.e2e_seconds", 3.0)
+    assert v["status"] == "ok"
+
+    short = tmp_path / "short.series.jsonl"
+    _write_series(short, [0.01, 0.5])
+    v = bench_trend.judge_series_p99(str(short), "score.e2e_seconds", 3.0)
+    assert v["status"] == "ok"
+    assert "report-only" in v["notes"][0]
+
+
+def test_bench_trend_p99_gate_end_to_end_exit_codes(tmp_path):
+    import bench_trend
+
+    _write_series(tmp_path / "creep.series.jsonl", [0.01, 0.011, 0.01, 0.2])
+    argv = [
+        "--history", str(tmp_path / "nothing*.json"),
+        "--northstar", "",
+        "--series", str(tmp_path / "*.series.jsonl"),
+    ]
+    assert bench_trend.main(argv) == 0  # report-only without tolerance
+    assert bench_trend.main(argv + ["--p99-tolerance", "3.0"]) == 3
+
+
+# -- load harness -----------------------------------------------------------
+
+
+def test_poisson_schedule_deterministic_and_rate_shaped():
+    import load_harness
+
+    a = load_harness.poisson_schedule(100.0, 1000, seed=1)
+    b = load_harness.poisson_schedule(100.0, 1000, seed=1)
+    np.testing.assert_array_equal(a, b)
+    assert list(a) == sorted(a)
+    # mean inter-arrival ~ 1/qps
+    assert np.diff(a).mean() == pytest.approx(0.01, rel=0.2)
+
+
+def test_load_harness_end_to_end_benign_and_stalled(tmp_path):
+    """The harness drives the real stream under Poisson arrivals and
+    reports p50/p90/p99/p99.9 end-to-end with queueing included; a
+    benign run passes its gate, and the report document carries the
+    curve fields bench's tail config publishes."""
+    import load_harness
+
+    doc = load_harness.run_load(
+        [50.0],
+        num_requests=6,
+        batch_rows=64,
+        spec="p99<=30s@60s",
+        seed=2,
+        out_dir=str(tmp_path),
+        workload_kwargs={"users": 8, "items": 4, "d": 8, "nnz": 4},
+    )
+    assert doc["gate_ok"] is True
+    assert doc["capacity_qps"] > 0
+    (leg,) = doc["legs"]
+    assert leg["requests"] == 6
+    lat = leg["latency_s"]
+    assert {"p50", "p90", "p99", "p99.9"} <= set(lat)
+    assert lat["p50"] <= lat["p99.9"]
+    assert (tmp_path / "slo_report.json").exists()
+    # SLO plane torn down after the harness
+    assert slo.active() is None and not obs.enabled()
+
+
+def test_load_harness_queueing_counts_against_budget():
+    """Coordinated-omission pin: with a per-request stall injected, the
+    OFFERED rate outpaces the pipeline, and e2e latency (from scheduled
+    arrival) must grow with the backlog — later requests wait longer —
+    rather than resetting per request as a closed loop would report."""
+    import load_harness
+
+    scorer, chunks = load_harness.build_workload(
+        num_requests=6, batch_rows=64, users=8, items=4, d=8, nnz=4,
+        seed=3,
+    )
+    slo.install("p90<=20ms@60s")
+    obs.enable()
+    with faults.injected("scoring.chunk@*=stall:0.15"):
+        arrivals = load_harness.poisson_schedule(200.0, len(chunks), 3)
+        result, _wall = load_harness.drive(scorer, chunks, arrivals)
+    walls = result.stats.e2e_walls_s
+    # the backlog accumulates: the last request waited for ~all prior
+    # stalls (arrivals all land in the first ~30ms, service is 150ms+)
+    assert walls[-1] > walls[0]
+    assert walls[-1] >= 0.4
+    assert result.stats.deadline_violations == len(chunks)
+    # the wait shows up as explicit wait stages (hand-off queue, the
+    # stalled decode, the double-buffer pipeline hold), never hidden in
+    # compute stages
+    by_stage = result.stats.violations_by_stage
+    assert set(by_stage) <= {"queue", "decode", "pipeline"}
+    assert by_stage.get("decode", 0) >= 1
+
+
+# -- bench quality bands for the tail config --------------------------------
+
+
+def test_tail_band_semantics():
+    import bench
+
+    healthy = {
+        "tail": {
+            "p99_s": 0.2,
+            "gate_ok": True,
+            "slo_violations": [],
+        }
+    }
+    assert bench.check_quality_bands("game_scoring_tail", healthy) == []
+    # missing section, exploded p99, and a failed gate each violate
+    assert bench.check_quality_bands("game_scoring_tail", {})
+    assert bench.check_quality_bands(
+        "game_scoring_tail",
+        {"tail": {"p99_s": 99.0, "gate_ok": True}},
+    )
+    v = bench.check_quality_bands(
+        "game_scoring_tail",
+        {
+            "tail": {
+                "p99_s": 0.2,
+                "gate_ok": False,
+                "slo_violations": ["burn rate 5 > 1 (dominant: decode)"],
+            }
+        },
+    )
+    assert v and "decode" in v[0]
+
+
+def test_scoring_summary_latency_keys_in_driver_detail():
+    """The driver-level waterfall satellite is pinned end-to-end in
+    tests/test_cli.py; this pins the StreamStats API the driver
+    consumes (stage percentiles keyed per stage, e2e incl. p99.9)."""
+    scorer, data, _ = _tiny_scorer(n=128, batch_rows=64)
+    res = scorer.stream(iter(_chunks(data, 64)), on_batch=lambda c, s: None)
+    wf = res.stats.stage_percentiles()
+    assert {"decode", "assemble", "h2d", "dispatch", "pipeline",
+            "readback", "write"} <= set(wf)
+    assert all({"p50", "p90", "p99"} == set(v) for v in wf.values())
