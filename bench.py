@@ -244,6 +244,13 @@ QUALITY_BANDS = {
         "tail_p99_s_max": 5.0,
         "tail_slo_ok": True,
     },
+    # the hot-swap config's whole claim is "zero downtime": a swap that
+    # failed or dropped even one request, or whose post-flip answers
+    # diverge from a cold scorer on the new model, must fail, not publish
+    "game_serving_swap": {
+        "serve_swap_failed_requests_max": 0,
+        "serve_swap_parity_max": 1e-6,
+    },
 }
 
 #: ConvergenceReason codes that mean "the tolerance check stopped us"
@@ -383,6 +390,36 @@ def check_quality_bands(name: str, detail: dict) -> list[str]:
                 "sustained leg breached its armed SLO: "
                 f"{'; '.join(tail.get('slo_violations') or ['no gate data'])}"
             )
+    swap_failed_max = band.get("serve_swap_failed_requests_max")
+    if swap_failed_max is not None:
+        failed = detail.get("failed_requests")
+        shed = detail.get("shed")
+        if failed is None or failed > swap_failed_max:
+            out.append(
+                f"hot swap under load failed/misanswered {failed} "
+                f"request(s) (> {swap_failed_max}; zero-downtime claim "
+                "broken)"
+            )
+        if shed is None or shed > swap_failed_max:
+            out.append(
+                f"hot swap under load shed {shed} request(s) "
+                f"(> {swap_failed_max}) at sustained sub-capacity traffic"
+            )
+        if not detail.get("swap"):
+            out.append("serving-swap row carries no swap record at all")
+    swap_parity_max = band.get("serve_swap_parity_max")
+    if swap_parity_max is not None:
+        par = detail.get("post_swap_parity_max_abs")
+        if par is None or not math.isfinite(par) or par > swap_parity_max:
+            out.append(
+                f"post-swap score parity {par} > {swap_parity_max} vs a "
+                "cold scorer on the new model"
+            )
+        if not detail.get("post_flip_requests"):
+            out.append(
+                "no post-flip requests were answered — the parity gate "
+                "measured nothing"
+            )
     if band.get("require_memory"):
         mem = detail.get("mem") or {}
         peak = mem.get("peak_bytes")
@@ -439,6 +476,10 @@ CONFIG_PLAN = [
     # paced legs reporting p50/p90/p99/p99.9 end-to-end with queueing
     # included, gated by the armed SLO
     ("game_scoring_tail", 900, 2),
+    # serving hot swap under load (ISSUE 16): paced traffic through the
+    # always-on engine, one mid-run zero-downtime model swap; in-process,
+    # AOT shapes only, so the budget is mostly the two model builds
+    ("game_serving_swap", 900, 2),
 ]
 
 #: BENCH_PARTIAL_PATH redirects the cumulative artifact — a CPU-pinned
@@ -2539,6 +2580,163 @@ def config_scoring_tail(peak_flops, scale):
     }
 
 
+# ---------------------------------------------------------------------------
+# Config: serving hot swap under load (ISSUE 16). Sustained paced traffic
+# through the always-on engine; one zero-downtime model hot swap lands
+# mid-run. Records the swap wall, how many requests were in flight at the
+# flip, shed/failed counts, and post-swap bit parity vs a cold scorer on
+# the new model. QUALITY_BANDS: zero failed requests, parity <= 1e-6.
+# ---------------------------------------------------------------------------
+
+
+def config_game_serving_swap(peak_flops, scale):
+    del peak_flops
+    import numpy as np
+
+    from photon_tpu import obs
+    from photon_tpu.game.data import slice_game_data
+    from photon_tpu.serve.admission import AdmissionQueue
+    from photon_tpu.serve.engine import ServingEngine
+    from photon_tpu.serve.registry import ModelRegistry, model_fingerprint
+
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"
+    )
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import load_harness
+
+    num_requests, batch_rows, users, d, nnz = _pick(
+        scale,
+        (24, 128, 64, 16, 8),
+        (96, 1024, 512, 32, 16),
+        (128, 4096, 4096, 64, 24),
+    )
+    rows_per_req = max(8, batch_rows // 4)
+    qps = _pick(scale, 40.0, 24.0, 24.0)
+
+    obs.enable()
+    try:
+        scorer_a, chunks = load_harness.build_workload(
+            num_requests=num_requests,
+            batch_rows=batch_rows,
+            d=d,
+            nnz=nnz,
+            users=users,
+            seed=16,
+        )
+        scorer_b, _ = load_harness.build_workload(
+            num_requests=num_requests,
+            batch_rows=batch_rows,
+            d=d,
+            nnz=nnz,
+            users=users,
+            seed=17,
+        )
+        requests = [slice_game_data(c, 0, rows_per_req) for c in chunks]
+        # cold oracles BEFORE the traffic window: their compiles must not
+        # pollute the engine's zero-traffic-compile accounting
+        exp_a = [scorer_a.score_data(r) for r in requests]
+        exp_b = [scorer_b.score_data(r) for r in requests]
+        fp_b = model_fingerprint(scorer_b.model)
+
+        reg = ModelRegistry()
+        reg.register(
+            "default",
+            scorer_a.model,
+            batch_rows=batch_rows,
+            ell_widths={"global": nnz},
+        )
+        queue = AdmissionQueue(
+            cap=max(64, num_requests), default_deadline_s=120.0,
+            max_rows=batch_rows,
+        )
+        engine = ServingEngine(
+            reg, queue, batch_rows=batch_rows, poll_s=0.005
+        )
+        engine.start()
+
+        flip_at = num_requests // 2
+        interval = 1.0 / qps
+        futures, post_flip, swap = [], [], None
+        t_run0 = time.perf_counter()
+        for i, req in enumerate(requests):
+            if i == flip_at:
+                t_sw0 = time.perf_counter()
+                staged = reg.begin_swap(
+                    "default", scorer_b.model, expect_fingerprint=fp_b
+                )
+                while reg.has_pending_swap("default"):
+                    if time.perf_counter() - t_sw0 > 60:
+                        raise RuntimeError("engine never applied the flip")
+                    time.sleep(0.0005)
+                in_flight_at_flip = sum(
+                    1 for f in futures if not f.done()
+                ) + reg.in_flight("default")
+                swap = {
+                    "swap_wall_s": round(time.perf_counter() - t_sw0, 6),
+                    "build_wall_s": staged["build_wall_s"],
+                    "in_flight_at_flip": in_flight_at_flip,
+                    "table_bytes": staged["table_bytes"],
+                }
+            fut = queue.submit(req, arrival_t=time.perf_counter())
+            futures.append(fut)
+            if swap is not None and i >= flip_at:
+                post_flip.append((i, fut))
+            target = t_run0 + (i + 1) * interval
+            lag = target - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+        stats = engine.stop()
+        traffic_wall_s = time.perf_counter() - t_run0
+
+        failed, parity_max, answered = 0, 0.0, 0
+        for i, fut in enumerate(futures):
+            try:
+                got = fut.result(timeout=5)
+            except Exception:
+                failed += 1
+                continue
+            answered += 1
+            # pre-flip answers match A or B (a request admitted before
+            # the flip may dispatch after it) — only definitely-post-flip
+            # submissions are held to new-model parity below
+            d_a = float(np.max(np.abs(got - exp_a[i]))) if len(got) else 0.0
+            d_b = float(np.max(np.abs(got - exp_b[i]))) if len(got) else 0.0
+            if min(d_a, d_b) > 0:
+                failed += 1
+        for i, fut in post_flip:
+            if not fut.done() or fut.exception() is not None:
+                continue
+            got = fut.result(timeout=0)
+            parity_max = max(
+                parity_max, float(np.max(np.abs(got - exp_b[i])))
+            )
+        summary = engine.summary()
+    finally:
+        obs.reset()
+        obs.disable()
+
+    return {
+        "n": num_requests * rows_per_req,
+        "num_requests": num_requests,
+        "rows_per_request": rows_per_req,
+        "offered_qps": qps,
+        "swap": swap,
+        "answered": answered,
+        "failed_requests": failed,
+        "shed": int(stats.shed),
+        "post_flip_requests": len(post_flip),
+        "post_swap_parity_max_abs": parity_max,
+        "traffic_compiles": summary["compiles"].get("backend_compiles"),
+        "swap_build_compiles": summary["swap_build_compiles"],
+        "e2e": stats.e2e_percentiles(),
+        "examples_per_sec": round(
+            answered * rows_per_req / max(traffic_wall_s, 1e-9), 2
+        ),
+    }
+
+
 CONFIG_FNS = {
     "a1a_logistic_lbfgs": config_a1a,
     "linear_tron": config_tron,
@@ -2547,6 +2745,7 @@ CONFIG_FNS = {
     "game_ctr_scale": config_game_ctr_scale,
     "game_scoring_stream": config_scoring_stream,
     "game_scoring_tail": config_scoring_tail,
+    "game_serving_swap": config_game_serving_swap,
 }
 
 

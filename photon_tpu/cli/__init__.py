@@ -1,9 +1,14 @@
 """Drivers / CLI layer (reference photon-client, L9).
 
-Five entry points, mirroring the reference's ``main()`` classes:
+Six entry points — five mirroring the reference's ``main()`` classes,
+plus the always-on serving driver the reference leaves to external
+infra:
 
 - ``photon_tpu.cli.game_training``   GAME training (GameTrainingDriver.scala:822)
 - ``photon_tpu.cli.game_scoring``    GAME scoring  (GameScoringDriver.scala:260)
+- ``photon_tpu.cli.game_serving``    always-on serving loop over a
+  request spool: bounded admission, typed load shedding, zero-downtime
+  hot swap (photon_tpu/serve)
 - ``photon_tpu.cli.legacy_driver``   single-GLM staged pipeline (Driver.scala:685)
 - ``photon_tpu.cli.feature_indexing`` native index-store builder
   (FeatureIndexingDriver.scala:307)

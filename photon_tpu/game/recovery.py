@@ -27,6 +27,20 @@ Failure taxonomy (``classify_failure``):
     divergence caused by a transient corruption (bit flip, bad
     read-back) recovers on replay, while a deterministic one recurs and
     burns through ``max_restarts`` into the loud failure it deserves.
+``load_shed``
+    A serving-side shed — :class:`~photon_tpu.serve.admission
+    .ServeSheddingError` (``AdmissionRejected`` / ``DeadlineExceeded``).
+    The engine did exactly what its admission policy promised under
+    overload; restart fuel must NEVER be spent re-running load the
+    device already said it cannot make (a restart would re-offer the
+    same overload to the same device).
+``rollback``
+    A hot-swap validation failure —
+    :class:`~photon_tpu.serve.registry.SwapValidationError` (fingerprint
+    mismatch, torn checkpoint via ``CheckpointCorruptError``, failed
+    precompile). The swap already rolled back and the previous model
+    never stopped serving, so this is an operational outcome, never
+    fatal to the process and never worth a restart either.
 ``fatal``
     Everything else — shape errors, config errors, OOM, corrupt-beyond-
     fallback checkpoints. Never retried: replaying a deterministic bug
@@ -72,7 +86,19 @@ DEFAULT_RESTART_POLICY = RetryPolicy(
 
 
 def classify_failure(exc: BaseException) -> str:
-    """``"transient"`` | ``"divergent"`` | ``"fatal"`` — see module doc."""
+    """``"transient"`` | ``"divergent"`` | ``"load_shed"`` |
+    ``"rollback"`` | ``"fatal"`` — see module doc. Only ``transient``
+    (and ``divergent``, by default) earn restart fuel; the serving
+    kinds re-raise with their counters bumped and nothing restarted."""
+    # deferred: the serve package pulls the scorer stack, which a bare
+    # training-side recovery import must not pay for
+    from photon_tpu.serve.admission import ServeSheddingError
+    from photon_tpu.serve.registry import SwapValidationError
+
+    if isinstance(exc, ServeSheddingError):
+        return "load_shed"
+    if isinstance(exc, SwapValidationError):
+        return "rollback"
     if isinstance(exc, DivergenceError):
         return "divergent"
     if is_transient(exc) or is_transient_io(exc):

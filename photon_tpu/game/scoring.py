@@ -265,6 +265,11 @@ class StreamStats:
     compiles: dict = dataclasses.field(default_factory=dict)
     compiles_first_batch: dict = dataclasses.field(default_factory=dict)
     wall_s: float = 0.0
+    #: requests shed instead of answered (serving engine: queue-full /
+    #: deadline / oversize rejections). Shed requests have NO e2e wall —
+    #: the percentiles below cover answered work only, and expose this
+    #: count alongside so a partial run cannot masquerade as a full one
+    shed: int = 0
 
     def latency_percentiles(self, warm_only: bool = True) -> dict:
         """p50/p95/p99 batch latency (warm = batch 0 excluded)."""
@@ -281,10 +286,15 @@ class StreamStats:
         """Exact (numpy, not bucketed) p50/p90/p99/p99.9 of end-to-end
         batch latency — queueing delay included. All batches by default:
         an open-loop load report must not exclude the cold batch its
-        arrivals already charged."""
+        arrivals already charged.
+
+        Percentiles cover ANSWERED work only — shed requests never get
+        an e2e wall — so ``count`` (answered) and ``shed`` ride along:
+        a report from a partial run (load shedding, a mid-stream stall)
+        must say how much work its percentiles describe."""
         walls = self.e2e_walls_s[1:] if warm_only else self.e2e_walls_s
         if not walls:
-            return {}
+            return {"count": 0, "shed": self.shed} if self.shed else {}
         arr = np.asarray(walls)
         out = {
             # phl-ok: PHL002 post-run numpy percentile of host walls, no device value involved
@@ -295,6 +305,8 @@ class StreamStats:
         out["mean"] = round(float(arr.mean()), 6)
         # phl-ok: PHL002 post-run numpy moment of host walls, no device value involved
         out["max"] = round(float(arr.max()), 6)
+        out["count"] = len(walls)
+        out["shed"] = self.shed
         return out
 
     def stage_percentiles(self) -> dict:

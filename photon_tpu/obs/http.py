@@ -402,6 +402,37 @@ def healthz_snapshot(registry=None) -> dict:
         },
         "slo": slo_health_section(),
     }
+    # the serving engine's admission/shed/swap censuses — present only
+    # when a serve plane has actually counted something, so training and
+    # scoring processes keep their /healthz shape
+    if any(k.startswith("serve.") for k in counters):
+        doc["serve"] = {
+            "admitted": counters.get("serve.admitted", 0),
+            "requests": counters.get("serve.requests", 0),
+            "batches": counters.get("serve.batches", 0),
+            "shed": counters.get("serve.shed", 0),
+            "shed_by_reason": {
+                k.split(".", 2)[2]: v
+                for k, v in sorted(counters.items())
+                if k.startswith("serve.shed.")
+                and not k.startswith("serve.shed.tenant.")
+            },
+            "shed_by_tenant": {
+                k.split(".", 3)[3]: v
+                for k, v in sorted(counters.items())
+                if k.startswith("serve.shed.tenant.")
+            },
+            "requests_by_tenant": {
+                k.split(".", 3)[3]: v
+                for k, v in sorted(counters.items())
+                if k.startswith("serve.requests.tenant.")
+            },
+            "dispatch_failures": counters.get("serve.dispatch_failures", 0),
+            "batch_retries": counters.get("serve.batch_retries", 0),
+            "swaps": counters.get("serve.swaps", 0),
+            "swap_rollbacks": counters.get("serve.swap_rollbacks", 0),
+            "evicted": counters.get("serve.evicted", 0),
+        }
     rec = flight.get_recorder()
     doc["recorder"] = (
         None

@@ -368,11 +368,21 @@ def report(registry=None) -> dict:
     hists = snap.get("histograms", {})
     counters = snap.get("counters", {})
     waterfall = {}
-    prefix = "score.stage_seconds."
-    for name in sorted(hists):
-        if name.startswith(prefix):
-            waterfall[name[len(prefix):]] = _hist_percentiles(hists[name])
-    e2e = _hist_percentiles(hists.get("score.e2e_seconds", {}))
+    # the serving engine records the same lifecycle stages under its own
+    # ``serve.*`` names; a process runs one plane or the other, and the
+    # batch-scoring names win on the (never expected) overlap
+    for prefix in ("serve.stage_seconds.", "score.stage_seconds."):
+        for name in sorted(hists):
+            if name.startswith(prefix):
+                waterfall[name[len(prefix):]] = _hist_percentiles(
+                    hists[name]
+                )
+    e2e_hist_name = "score.e2e_seconds"
+    if not hists.get(e2e_hist_name, {}) and hists.get(
+        "serve.e2e_seconds", {}
+    ):
+        e2e_hist_name = "serve.e2e_seconds"
+    e2e = _hist_percentiles(hists.get(e2e_hist_name, {}))
     t = _TRACKER
     doc: dict = {
         "armed": t is not None,
@@ -386,14 +396,18 @@ def report(registry=None) -> dict:
         "e2e": e2e,
         "waterfall": waterfall,
         "counters": {
-            k: v for k, v in sorted(counters.items()) if k.startswith("slo.")
+            k: v
+            for k, v in sorted(counters.items())
+            # the serving engine's shed/admission censuses belong next
+            # to the burn rates they explain
+            if k.startswith(("slo.", "serve."))
         },
     }
     if t is not None and e2e["count"]:
         from photon_tpu.obs.metrics import percentile_from_buckets
 
         observed = percentile_from_buckets(
-            hists["score.e2e_seconds"], t.spec.percentile
+            hists[e2e_hist_name], t.spec.percentile
         )
         doc["objective"] = {
             "percentile": t.spec.percentile,

@@ -1,0 +1,50 @@
+"""Always-on serving engine (ROADMAP item 1, the half PR 15 measured).
+
+``photon_tpu/serve`` keeps GAME model tables resident on device across
+requests and makes every overload and failure mode a *policied* outcome
+instead of a hang or a crash:
+
+- :mod:`photon_tpu.serve.admission` — the bounded admission queue with
+  per-request deadlines and typed load shedding
+  (:class:`AdmissionRejected` / :class:`DeadlineExceeded`, counted via
+  ``serve.shed.*``);
+- :mod:`photon_tpu.serve.registry` — the multi-tenant model registry
+  priced by the device-memory ledger, with double-buffered zero-downtime
+  hot swap (:class:`SwapValidationError` rolls back, never drops);
+- :mod:`photon_tpu.serve.engine` — the persistent micro-batching
+  dispatch loop over the fused AOT-precompiled scorer (zero traffic-time
+  compiles stays a hard gate);
+- :mod:`photon_tpu.serve.spool` — the filesystem request/result
+  transport the chaos drive SIGKILLs the server across.
+"""
+from photon_tpu.serve.admission import (
+    AdmissionQueue,
+    AdmissionRejected,
+    DeadlineExceeded,
+    ServeRequest,
+    ServeSheddingError,
+    serve_deadline_s,
+    serve_queue_cap,
+)
+from photon_tpu.serve.engine import ServingEngine
+from photon_tpu.serve.registry import (
+    ModelRegistry,
+    ServeMemoryBudgetError,
+    SwapValidationError,
+    model_fingerprint,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "AdmissionRejected",
+    "DeadlineExceeded",
+    "ModelRegistry",
+    "ServeMemoryBudgetError",
+    "ServeRequest",
+    "ServeSheddingError",
+    "ServingEngine",
+    "SwapValidationError",
+    "model_fingerprint",
+    "serve_deadline_s",
+    "serve_queue_cap",
+]

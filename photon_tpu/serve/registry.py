@@ -1,0 +1,484 @@
+"""Multi-tenant model registry: device-priced residency + hot swap.
+
+Several ``GameModel``s share one device. Each tenant's entry owns a
+:class:`~photon_tpu.game.scoring.GameScorer` whose packed coefficient
+tables are device-resident for the life of the entry — the whole point
+of a persistent serving loop is never paying model H2D per request. The
+registry prices every load with the PR 7 memory ledger
+(``obs.memory.tree_device_bytes`` over the scorer's params pytree) and
+refuses loads that would blow ``PHOTON_SERVE_MEM_BYTES`` — a typed
+:class:`ServeMemoryBudgetError`, never a device OOM mid-traffic.
+
+**Zero-downtime hot swap** is double-buffered: ``begin_swap`` builds
+and AOT-precompiles the NEW scorer (the second buffer) while the old
+one keeps serving; the engine then flips atomically between dispatches
+(:meth:`apply_pending_swap` under the entry lock — the ``serve.swap``
+fault point sits inside this critical section). In-flight batches hold
+LEASES on the scorer they dispatched against, so a flipped-out scorer
+drains: its device tables are released (``serve.evict`` fault point,
+``serve.evicted`` counter) only when the last old-model dispatch
+retires. A swap that fails validation — fingerprint mismatch, torn
+model load (PR 10's ``CheckpointCorruptError`` path), a layout the
+fused scorer rejects, a failed precompile — raises
+:class:`SwapValidationError` and ROLLS BACK: the candidate is
+discarded, the old scorer never stopped serving, no request drops.
+``classify_failure`` maps it to ``rollback`` — never fatal, never
+restart fuel.
+
+**Durability**: ``save_manifest`` writes ``registry.json`` (tenant →
+model dir + fingerprint) with the tmp+rename discipline; a SIGKILLed
+server's relaunch reloads it and resumes serving the same tenants —
+the chaos drive's leg C proves it.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Mapping
+
+import numpy as np
+
+from photon_tpu import obs
+from photon_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    MatrixFactorizationModel,
+    RandomEffectModel,
+)
+from photon_tpu.game.scoring import GameScorer
+from photon_tpu.util import compile_watch, faults
+
+__all__ = [
+    "ModelRegistry",
+    "ServeMemoryBudgetError",
+    "SwapValidationError",
+    "model_fingerprint",
+    "serve_mem_budget_bytes",
+]
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_NAME = "registry.json"
+
+
+class SwapValidationError(RuntimeError):
+    """A hot-swap candidate failed validation (fingerprint mismatch,
+    torn/corrupt model load, incompatible layout, failed precompile).
+    The swap ROLLED BACK — the previous model never stopped serving —
+    so this is an operational outcome, not a process failure:
+    ``classify_failure`` maps it to ``rollback``."""
+
+
+class ServeMemoryBudgetError(RuntimeError):
+    """Registering this model would blow the device-memory budget
+    (``PHOTON_SERVE_MEM_BYTES``). Raised at load time with the ledger's
+    own numbers — never a device OOM mid-traffic."""
+
+
+def serve_mem_budget_bytes(config_value: int | None = None) -> int | None:
+    """Device budget for resident model tables: ``PHOTON_SERVE_MEM_BYTES``
+    env > explicit value > None (unlimited)."""
+    env = os.environ.get("PHOTON_SERVE_MEM_BYTES", "").strip()
+    if env:
+        v = int(env)
+    elif config_value is not None:
+        v = int(config_value)
+    else:
+        return None
+    if v < 1:
+        raise ValueError(f"serve memory budget must be >= 1 byte, got {v}")
+    return v
+
+
+def model_fingerprint(model: GameModel) -> str:
+    """Order-stable sha256 over every coefficient array of a GameModel —
+    the in-memory twin of the chaos drive's on-disk ``model_hash``
+    oracle, and the identity a swap validates against."""
+    h = hashlib.sha256()
+    for cid in sorted(model.coordinates):
+        cm = model.coordinates[cid]
+        h.update(cid.encode())
+        if isinstance(cm, FixedEffectModel):
+            h.update(
+                np.ascontiguousarray(cm.model.coefficients.means).tobytes()
+            )
+        elif isinstance(cm, RandomEffectModel):
+            for b in cm.buckets:
+                h.update(np.ascontiguousarray(b.entity_ids).tobytes())
+                h.update(np.ascontiguousarray(b.coefficients).tobytes())
+        elif isinstance(cm, MatrixFactorizationModel):
+            h.update(np.ascontiguousarray(cm.row_factors).tobytes())
+            h.update(np.ascontiguousarray(cm.col_factors).tobytes())
+        else:
+            raise ValueError(f"unknown coordinate model for {cid!r}")
+    return h.hexdigest()
+
+
+class _TenantEntry:
+    """One tenant's serving state: the active scorer, a pending
+    (validated, precompiled) swap candidate, the draining set, and the
+    per-scorer lease counts the drain protocol runs on. The lock guards
+    flips and lease transitions only — dispatches run outside it."""
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+        self.lock = threading.Lock()
+        self.active: GameScorer | None = None
+        self.fingerprint: str | None = None
+        self.model_dir: str | None = None
+        self.table_bytes = 0
+        self.pending: GameScorer | None = None
+        self.pending_fingerprint: str | None = None
+        self.pending_model_dir: str | None = None
+        self.pending_table_bytes = 0
+        #: id(scorer) → in-flight dispatch count
+        self.leases: dict[int, int] = {}
+        #: flipped-out scorers still owed a read-back
+        self.draining: dict[int, GameScorer] = {}
+        self.swaps = 0
+
+
+class ModelRegistry:
+    """Tenant → device-resident scorer, priced and swap-capable."""
+
+    def __init__(
+        self,
+        *,
+        mem_budget_bytes: int | None = None,
+        manifest_path: str | None = None,
+    ):
+        self.mem_budget_bytes = serve_mem_budget_bytes(mem_budget_bytes)
+        self.manifest_path = manifest_path
+        self._entries: dict[str, _TenantEntry] = {}
+        self._lock = threading.Lock()
+        #: backend compiles spent building swap CANDIDATES — the one
+        #: legitimate compile source inside the traffic window, so the
+        #: zero-traffic-compile gate is
+        #: ``engine_compiles == swap_build_compiles``
+        self.swap_build_compiles = 0
+
+    # -- residency ----------------------------------------------------------
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def entry(self, tenant: str) -> _TenantEntry:
+        with self._lock:
+            e = self._entries.get(tenant)
+        if e is None or e.active is None:
+            raise KeyError(f"tenant {tenant!r} is not registered")
+        return e
+
+    def total_table_bytes(self) -> int:
+        with self._lock:
+            entries = list(self._entries.values())
+        total = 0
+        for e in entries:
+            with e.lock:
+                total += e.table_bytes + e.pending_table_bytes
+        return total
+
+    def _build_scorer(
+        self,
+        model: GameModel,
+        *,
+        batch_rows: int | None,
+        ell_widths: Mapping[str, int] | None,
+        precompile_keys: list[tuple] | None = None,
+    ) -> tuple[GameScorer, int]:
+        """Build + AOT-precompile one scorer buffer and price its device
+        tables. Precompiling at load/swap time is what keeps traffic
+        time compile-free — the acceptance gate."""
+        scorer = GameScorer(model, batch_rows=batch_rows)
+        if precompile_keys:
+            for key in precompile_keys:
+                scorer.precompile(ell_widths=dict(key))
+        else:
+            scorer.precompile(ell_widths=ell_widths)
+        table_bytes = obs.memory.tree_device_bytes(scorer._params)
+        return scorer, table_bytes
+
+    def register(
+        self,
+        tenant: str,
+        model: GameModel,
+        *,
+        model_dir: str | None = None,
+        batch_rows: int | None = None,
+        ell_widths: Mapping[str, int] | None = None,
+    ) -> dict:
+        """Load a tenant's model: build the scorer, precompile its batch
+        shape, price the tables against the budget, publish. Returns the
+        priced entry summary."""
+        with obs.span("serve.register", tenant=tenant):
+            scorer, table_bytes = self._build_scorer(
+                model, batch_rows=batch_rows, ell_widths=ell_widths
+            )
+            budget = self.mem_budget_bytes
+            if budget is not None:
+                resident = self.total_table_bytes()
+                if resident + table_bytes > budget:
+                    # the candidate's tables die with this frame — the
+                    # ledger numbers make the refusal explainable
+                    raise ServeMemoryBudgetError(
+                        f"loading tenant {tenant!r} needs {table_bytes} "
+                        f"table bytes on top of {resident} resident — over "
+                        f"the {budget} byte budget "
+                        "(PHOTON_SERVE_MEM_BYTES)"
+                    )
+            fp = model_fingerprint(model)
+            with self._lock:
+                e = self._entries.setdefault(tenant, _TenantEntry(tenant))
+            with e.lock:
+                if e.active is not None:
+                    raise ValueError(
+                        f"tenant {tenant!r} already registered — use "
+                        "begin_swap for a live replacement"
+                    )
+                e.active = scorer
+                e.fingerprint = fp
+                e.model_dir = model_dir
+                e.table_bytes = table_bytes
+        obs.counter("serve.models_loaded")
+        obs.instant(
+            "serve.model_loaded",
+            cat="lifecycle",
+            tenant=tenant,
+            table_bytes=table_bytes,
+            fingerprint=fp[:16],
+        )
+        self.save_manifest()
+        return {
+            "tenant": tenant,
+            "fingerprint": fp,
+            "table_bytes": table_bytes,
+        }
+
+    # -- leases (the drain protocol) ----------------------------------------
+
+    def acquire(self, tenant: str) -> GameScorer:
+        """Take a dispatch lease on the tenant's ACTIVE scorer. The
+        returned scorer is pinned — a concurrent flip moves it to the
+        draining set, but its tables survive until :meth:`release`."""
+        e = self.entry(tenant)
+        with e.lock:
+            scorer = e.active
+            e.leases[id(scorer)] = e.leases.get(id(scorer), 0) + 1
+            return scorer
+
+    def release(self, tenant: str, scorer: GameScorer) -> None:
+        """Retire one dispatch lease. The last lease on a DRAINING
+        scorer frees its device tables (the old buffer of a completed
+        swap) — never before."""
+        e = self.entry(tenant)
+        evicted = False
+        with e.lock:
+            sid = id(scorer)
+            n = e.leases.get(sid, 0) - 1
+            if n > 0:
+                e.leases[sid] = n
+            else:
+                e.leases.pop(sid, None)
+                if sid in e.draining:
+                    faults.fault_point("serve.evict")
+                    e.draining.pop(sid)
+                    evicted = True
+        if evicted:
+            # outside the lock: dropping the last reference releases the
+            # old tables (jax buffers free with their handles)
+            obs.counter("serve.evicted")
+            obs.instant(
+                "serve.old_model_evicted", cat="lifecycle", tenant=tenant
+            )
+
+    def in_flight(self, tenant: str) -> int:
+        e = self.entry(tenant)
+        with e.lock:
+            return sum(e.leases.values())
+
+    # -- hot swap -----------------------------------------------------------
+
+    def begin_swap(
+        self,
+        tenant: str,
+        loader: Callable[[], GameModel] | GameModel,
+        *,
+        model_dir: str | None = None,
+        expect_fingerprint: str | None = None,
+        batch_rows: int | None = None,
+    ) -> dict:
+        """Stage a validated, precompiled swap candidate (the second
+        buffer). Validation failures — a loader that raises (torn
+        checkpoint: ``CheckpointCorruptError`` rides this path), a
+        fingerprint mismatch, a layout the fused scorer rejects, a
+        failed precompile — raise :class:`SwapValidationError` and leave
+        the active scorer untouched. The engine applies the flip between
+        dispatches via :meth:`apply_pending_swap`."""
+        e = self.entry(tenant)
+        old = e.active
+        t0 = time.perf_counter()
+        try:
+            with obs.span("serve.swap_build", tenant=tenant):
+                model = loader() if callable(loader) else loader
+                fp = model_fingerprint(model)
+                if expect_fingerprint is not None and fp != expect_fingerprint:
+                    raise SwapValidationError(
+                        f"swap candidate for tenant {tenant!r} fingerprints "
+                        f"{fp[:16]}…, expected {expect_fingerprint[:16]}… — "
+                        "refusing to serve a model that is not the one "
+                        "promised"
+                    )
+                # the second buffer precompiles the SAME shape keys the
+                # live scorer serves, so the first post-flip batch hits
+                # the AOT cache — zero traffic-time compiles across a swap
+                cw0 = compile_watch.snapshot()
+                scorer, table_bytes = self._build_scorer(
+                    model,
+                    batch_rows=(
+                        batch_rows if batch_rows is not None
+                        else (old.batch_rows if old is not None else None)
+                    ),
+                    ell_widths=None,
+                    precompile_keys=(
+                        [k for k in old.aot_executables()]
+                        if old is not None and old.aot_executables()
+                        else None
+                    ),
+                )
+                self.swap_build_compiles += compile_watch.delta(cw0)[
+                    "backend_compiles"
+                ]
+        except SwapValidationError:
+            obs.counter("serve.swap_rollbacks")
+            raise
+        except Exception as exc:
+            obs.counter("serve.swap_rollbacks")
+            raise SwapValidationError(
+                f"swap candidate for tenant {tenant!r} failed validation "
+                f"({type(exc).__name__}: {exc}); previous model keeps "
+                "serving"
+            ) from exc
+        with e.lock:
+            e.pending = scorer
+            e.pending_fingerprint = fp
+            e.pending_model_dir = model_dir
+            e.pending_table_bytes = table_bytes
+        obs.counter("serve.swaps_staged")
+        return {
+            "tenant": tenant,
+            "fingerprint": fp,
+            "table_bytes": table_bytes,
+            "build_wall_s": round(time.perf_counter() - t0, 4),
+        }
+
+    def has_pending_swap(self, tenant: str) -> bool:
+        e = self.entry(tenant)
+        with e.lock:
+            return e.pending is not None
+
+    def apply_pending_swap(self, tenant: str) -> bool:
+        """THE atomic flip, called by the engine between dispatches.
+        Under the entry lock: the old scorer moves to the draining set
+        (tables freed by the LAST lease release), the candidate becomes
+        active. The ``serve.swap`` fault point sits inside this critical
+        section — a ``stall`` here holds the flip (and the dispatch loop)
+        open, exactly the chaos scenario. Returns True when a flip
+        happened."""
+        e = self.entry(tenant)
+        with e.lock:
+            if e.pending is None:
+                return False
+            faults.fault_point("serve.swap")
+            old = e.active
+            old_id = id(old)
+            if e.leases.get(old_id):
+                e.draining[old_id] = old
+                drains = True
+            else:
+                drains = False
+            e.active = e.pending
+            e.fingerprint = e.pending_fingerprint
+            e.model_dir = e.pending_model_dir or e.model_dir
+            e.table_bytes = e.pending_table_bytes
+            e.pending = None
+            e.pending_fingerprint = None
+            e.pending_model_dir = None
+            e.pending_table_bytes = 0
+            e.swaps += 1
+        obs.counter("serve.swaps")
+        obs.instant(
+            "serve.swap_flipped",
+            cat="lifecycle",
+            tenant=tenant,
+            fingerprint=(e.fingerprint or "")[:16],
+            old_draining=drains,
+        )
+        if not drains:
+            # no in-flight old dispatches: the old buffer frees now
+            faults.fault_point("serve.evict")
+            obs.counter("serve.evicted")
+        self.save_manifest()
+        return True
+
+    # -- durability ---------------------------------------------------------
+
+    def save_manifest(self, path: str | None = None) -> str | None:
+        """Atomically publish ``registry.json`` (tenant → model dir +
+        fingerprint) so a relaunch after SIGKILL reloads the same
+        tenants. Tmp+rename — a killed writer leaves the previous
+        manifest or none, never half."""
+        path = path or self.manifest_path
+        if path is None:
+            return None
+        with self._lock:
+            entries = dict(self._entries)
+        doc = {}
+        for tenant, e in sorted(entries.items()):
+            with e.lock:
+                if e.active is None or e.model_dir is None:
+                    continue
+                doc[tenant] = {
+                    "model_dir": e.model_dir,
+                    "fingerprint": e.fingerprint,
+                    "table_bytes": e.table_bytes,
+                    "swaps": e.swaps,
+                }
+        tmp = f"{path}.tmp-{os.getpid()}"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def load_manifest(path: str) -> dict:
+        """Read a ``registry.json`` back (the relaunch path). Raises
+        ``FileNotFoundError``/``ValueError`` loudly — a torn manifest
+        must not silently serve zero tenants."""
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"registry manifest {path!r} is not an object")
+        return doc
+
+    def snapshot(self) -> dict:
+        """Host-only registry state for ``/healthz`` and summaries."""
+        with self._lock:
+            entries = dict(self._entries)
+        out = {}
+        for tenant, e in sorted(entries.items()):
+            with e.lock:
+                out[tenant] = {
+                    "fingerprint": (e.fingerprint or "")[:16],
+                    "table_bytes": e.table_bytes,
+                    "swaps": e.swaps,
+                    "in_flight": sum(e.leases.values()),
+                    "draining": len(e.draining),
+                    "pending_swap": e.pending is not None,
+                }
+        return out

@@ -30,9 +30,15 @@ table): ``coordinate.placement``, ``sparse.placement``, ``io.decode``,
 ``io.native_decode``, ``io.shard_flush``, ``descent.sweep``,
 ``descent.coordinate`` (NaN injection), ``checkpoint.write``,
 ``checkpoint.replace``, ``scoring.producer``, ``scoring.chunk``,
-``scoring.batch``, and the feature-cache paths ``cache.write`` (per
+``scoring.batch``, the feature-cache paths ``cache.write`` (per
 appended chunk), ``cache.replace`` (the publish rename window),
-``cache.open`` (reader open/validate), ``cache.read`` (mmap replay).
+``cache.open`` (reader open/validate), ``cache.read`` (mmap replay),
+and the serving-engine paths ``serve.admit`` (inside
+``AdmissionQueue.submit``), ``serve.dispatch`` (per micro-batch, inside
+the retry-with-requeue scope), ``serve.swap`` (inside the locked
+atomic-flip critical section — ``stall`` holds a flip open mid-swap),
+``serve.evict`` (as the last lease on a drained old model retires its
+device tables).
 
 Fault plan
 ----------

@@ -35,7 +35,19 @@ fault injected into worker 1's sweep loop, and probe:
 3. both workers exit 0 and ``scripts/fleet_report.py`` yields per-sweep
    arrival-skew rows over the shared obs root.
 
+Serve mode (``--serve URL``, ISSUE 16): watch an already-running
+serving process (``photon_tpu.cli.game_serving``) instead of launching
+one — poll its ``/healthz`` and ``/slo`` for ``--polls`` rounds and
+exit non-zero if the burn rate stays above the gate
+(``photon_tpu.obs.slo.gate_max_burn``, env ``PHOTON_SLO_GATE_BURN``)
+for ``--sustain`` consecutive polls. A single hot poll is an excursion
+(chaos legs cause those on purpose); sustained burn is an unhealthy
+serving plane. ``scripts/serve_chaos.py`` runs this against the
+recovered plane after each fault leg.
+
 Usage: python scripts/live_probe.py [--workdir DIR] [--n 400] [--fleet]
+       python scripts/live_probe.py --serve http://127.0.0.1:PORT \
+           [--polls 12] [--interval 1.0] [--sustain 3] [--gate F]
 """
 from __future__ import annotations
 
@@ -67,6 +79,79 @@ def free_port() -> int:
 def get(url: str, timeout: float = 5.0) -> bytes:
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read()
+
+
+def sustained_burn(
+    samples: list[dict], gate: float, sustain: int
+) -> tuple[bool, str]:
+    """Decide whether a sequence of ``/slo`` burn-rate documents shows
+    SUSTAINED burn above the gate: ``sustain`` consecutive polls in
+    which any window with traffic burns hotter than ``gate``. Windows
+    with no batches (rate ``None``) are not evidence either way.
+    Returns ``(unhealthy, reason)`` — pure logic, unit-testable."""
+    streak = 0
+    for i, burn in enumerate(samples):
+        rates = [
+            float(b["rate"])
+            for b in (burn or {}).values()
+            if isinstance(b, dict) and b.get("rate") is not None
+        ]
+        if rates and max(rates) > gate:
+            streak += 1
+            if streak >= sustain:
+                return True, (
+                    f"burn rate above gate {gate:g} for {streak} "
+                    f"consecutive polls (last max {max(rates):.2f}, "
+                    f"poll {i + 1}/{len(samples)})"
+                )
+        else:
+            streak = 0
+    return False, f"no {sustain}-poll burn streak above gate {gate:g}"
+
+
+def probe_serve(args) -> int:
+    """The serve poll mode (see module docstring)."""
+    from photon_tpu.obs.slo import gate_max_burn
+
+    gate = args.gate if args.gate is not None else gate_max_burn()
+    base = args.serve.rstrip("/")
+
+    hz = json.loads(get(base + "/healthz"))
+    if hz.get("status") not in ("ok", "diverged"):
+        raise SystemExit(
+            f"[serve-probe] /healthz status {hz.get('status')!r}"
+        )
+    serve_doc = hz.get("serve") or {}
+    print(
+        f"[serve-probe] /healthz ok: status={hz['status']} "
+        f"admitted={serve_doc.get('admitted')} "
+        f"shed={serve_doc.get('shed')} swaps={serve_doc.get('swaps')}"
+    )
+
+    samples: list[dict] = []
+    for i in range(args.polls):
+        sl = json.loads(get(base + "/slo"))
+        if not sl.get("armed"):
+            raise SystemExit(
+                "[serve-probe] /slo not armed — a serving process "
+                "without an SLO spec has no burn plane to watch"
+            )
+        burn = sl.get("burn_rates") or {}
+        samples.append(burn)
+        rates = {
+            label: b.get("rate")
+            for label, b in burn.items()
+            if isinstance(b, dict)
+        }
+        print(f"[serve-probe] poll {i + 1}/{args.polls}: burn={rates}")
+        if i + 1 < args.polls:
+            time.sleep(args.interval)
+
+    unhealthy, reason = sustained_burn(samples, gate, args.sustain)
+    if unhealthy:
+        raise SystemExit(f"[serve-probe] UNHEALTHY: {reason}")
+    print(f"[serve-probe] healthy: {reason}. SERVE PROBE GREEN")
+    return 0
 
 
 def probe_fleet(args) -> int:
@@ -331,8 +416,26 @@ def main() -> int:
         help="run the 2-process Gloo fleet lane instead of the single "
         "driver probe",
     )
+    ap.add_argument(
+        "--serve", default=None, metavar="URL",
+        help="watch an already-running serving process at this base URL "
+        "instead of launching a driver (exit non-zero on sustained "
+        "burn above the gate)",
+    )
+    ap.add_argument("--polls", type=int, default=12,
+                    help="serve mode: number of /slo polls")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="serve mode: seconds between polls")
+    ap.add_argument("--sustain", type=int, default=3,
+                    help="serve mode: consecutive hot polls that count "
+                    "as unhealthy")
+    ap.add_argument("--gate", type=float, default=None,
+                    help="serve mode: burn-rate gate (default "
+                    "PHOTON_SLO_GATE_BURN or 1.0)")
     args = ap.parse_args()
 
+    if args.serve:
+        return probe_serve(args)
     if args.fleet:
         return probe_fleet(args)
 

@@ -1,0 +1,798 @@
+#!/usr/bin/env python
+"""Chaos-under-traffic proof for the always-on serving engine.
+
+The CI `serve-chaos` job's workload (ISSUE 16): drive sustained Poisson
+QPS through the real serving driver (``photon_tpu.cli.game_serving``,
+filesystem spool transport) with a latency SLO armed
+(``PHOTON_SLO_SPEC``), kill things mid-traffic, and assert the three
+recovery contracts on the LIVE ``/slo`` burn plane:
+
+  leg 1  producer SIGKILL — the request source dies mid-schedule and is
+         relaunched with the SAME wall-clock schedule, so the catch-up
+         burst carries past-due arrival stamps: burn rate must exceed
+         1.0 during the excursion and fall back below 1.0 under the
+         on-time tail; every request answered, zero sheds, bit parity
+         against a cold scorer.
+  leg 2  hot swap under traffic with a mid-flip stall
+         (``serve.swap@1=stall:3``) — first a swap pinned to a WRONG
+         fingerprint must roll back (``recovery.failures.rollback``,
+         serving uninterrupted), then the real swap must apply with
+         zero failed requests: every answer matches the old OR the new
+         model, and every request that arrived after the published
+         "applied" outcome bit-matches a cold scorer on the NEW model.
+  leg 3  server SIGKILL (``serve.dispatch@N=kill``) while the producer
+         keeps writing — the relaunch (``--resume``) reloads the
+         registry manifest and serves the backlog late (burn excursion,
+         then recovery); at-least-once across the crash: every seq gets
+         an answer, all scores bit-match the cold scorer.
+
+Every leg also enforces the zero-traffic-time-compile gate from the
+server's own summary (``backend_compiles == swap_build_compiles``) and
+leg 1 runs ``scripts/live_probe.py --serve`` against the recovered
+plane.
+
+The ``--producer`` subcommand is the load source: it stamps request
+envelopes with their SCHEDULED wall-clock arrival (open loop — late
+emission does not forgive latency) and is intentionally light to
+import, so a relaunch catches up in O(backlog) not O(interpreter).
+
+Usage: python scripts/serve_chaos.py [--workdir DIR] [--n 400] [--leg L]
+Exit 0 = every leg green; non-zero with a named failure otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from chaos_drive import SHARD_ARG, make_records, run_cli, training_args, write_data  # noqa: E402
+from live_probe import free_port, get  # noqa: E402
+
+#: one fixed serving batch shape — requests pack 4-to-a-batch at most
+BATCH_ROWS = 64
+ROWS_PER_REQ = 16
+#: p95 over a short window so one late burst is a visible excursion
+#: (error budget 0.05: >5% violating requests in a window => burn > 1)
+SLO_SPEC = "p95<=500ms@8s"
+#: generous per-request budget: chaos legs want LATE answers, not sheds
+DEADLINE_S = 120.0
+QPS = 8.0
+
+_SEQ_RE = re.compile(r"^(?:req|res)-(\d{6})\.npz$")
+
+
+def die(msg: str, *logs: str) -> None:
+    for lp in logs:
+        try:
+            print(f"--- log tail: {lp} ---")
+            print(open(lp).read()[-4000:])
+        except OSError:
+            pass
+    raise SystemExit(f"[serve-chaos] {msg}")
+
+
+# -- the producer subcommand (light imports, wall-clock schedule) -----------
+
+
+def arrival_offsets(qps: float, num: int, seed: int) -> np.ndarray:
+    """Cumulative Poisson arrival offsets — deterministic per seed, so a
+    relaunched producer recomputes the SAME schedule it was killed on."""
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / qps, size=num))
+
+
+def emit_request(staging: str, spool_dir: str, seq: int, arrival_wall: float):
+    """Publish staged request ``seq`` into the spool with its scheduled
+    arrival stamp patched in (tmp+rename, same atomicity as the spool)."""
+    src = os.path.join(staging, f"req-{seq:06d}.npz")
+    with np.load(src, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    meta = json.loads(str(arrays["meta"]))
+    meta["arrival_wall"] = float(arrival_wall)
+    arrays["meta"] = np.array(json.dumps(meta))
+    path = os.path.join(spool_dir, f"req-{seq:06d}.npz")
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def run_producer(args) -> int:
+    offsets = arrival_offsets(args.qps, args.num, args.seed)
+    os.makedirs(args.spool, exist_ok=True)
+    for seq in range(args.start_seq, args.num + 1):
+        target = args.t0 + float(offsets[seq - 1])
+        # phl-ok: PHL006 epoch anchor — paces emission against the cross-incarnation wall schedule
+        delay = target - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        # open loop: the stamp is the SCHEDULE, not the emission time —
+        # a producer running late (the catch-up burst after a SIGKILL
+        # relaunch) hands the server past-due arrivals on purpose
+        emit_request(args.staging, args.spool, seq, target)
+    return 0
+
+
+def start_producer(
+    staging: str,
+    spool_dir: str,
+    *,
+    num: int,
+    qps: float,
+    seed: int,
+    t0: float,
+    start_seq: int = 1,
+    log_path: str,
+) -> subprocess.Popen:
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--producer",
+        "--staging", staging, "--spool", spool_dir,
+        "--num", str(num), "--qps", str(qps), "--seed", str(seed),
+        "--t0", repr(t0), "--start-seq", str(start_seq),
+    ]
+    return subprocess.Popen(
+        cmd, cwd=REPO,
+        stdout=open(log_path, "a"), stderr=subprocess.STDOUT,
+    )
+
+
+# -- fixtures: two trained models + staged request envelopes ----------------
+
+
+def build_fixtures(work: str, n: int) -> dict:
+    """Train model A and model B (distinct data seeds => distinct
+    fingerprints), slice the score split into fixed-row request chunks,
+    compute each chunk's COLD expected scores under both models, and
+    stage every request envelope the producers will emit."""
+    from photon_tpu.game.data import slice_game_data
+    from photon_tpu.game.scoring import GameScorer
+    from photon_tpu.io.avro import write_avro_file
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.model_io import load_game_model, read_model_feature_keys
+    from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+    from photon_tpu.cli.game_base import read_game_data
+    from photon_tpu.serve import spool
+    from photon_tpu.serve.registry import model_fingerprint
+
+    data_a = os.path.join(work, "data_a")
+    write_data(data_a, n)
+    data_b = os.path.join(work, "data_b")
+    d = os.path.join(data_b, "train")
+    os.makedirs(d, exist_ok=True)
+    write_avro_file(
+        os.path.join(d, "part-00000.avro"),
+        TRAINING_EXAMPLE_AVRO,
+        make_records(7, n),
+    )
+
+    out_a = os.path.join(work, "train_a")
+    run_cli(
+        "photon_tpu.cli.game_training",
+        training_args(data_a, out_a),
+        label="train model A",
+    )
+    out_b = os.path.join(work, "train_b")
+    run_cli(
+        "photon_tpu.cli.game_training",
+        training_args(data_b, out_b),
+        label="train model B",
+    )
+    model_a_dir = os.path.join(out_a, "best")
+    model_b_dir = os.path.join(out_b, "best")
+
+    shard_configs = {"global": FeatureShardConfig(feature_bags=("features",))}
+    maps = read_model_feature_keys(model_a_dir, shard_configs)
+    model_a = load_game_model(model_a_dir, maps)
+    maps_b = read_model_feature_keys(model_b_dir, shard_configs)
+    model_b = load_game_model(model_b_dir, maps_b)
+    fp_a = model_fingerprint(model_a)
+    fp_b = model_fingerprint(model_b)
+    if fp_a == fp_b:
+        die("fixture models A and B have identical fingerprints")
+
+    data, _ = read_game_data(
+        [os.path.join(data_a, "score")],
+        shard_configs,
+        maps,
+        id_tags=tuple(sorted(model_a.required_id_tags())),
+    )
+    num_chunks = data.num_samples // ROWS_PER_REQ
+    chunks = [
+        slice_game_data(data, i * ROWS_PER_REQ, (i + 1) * ROWS_PER_REQ)
+        for i in range(num_chunks)
+    ]
+
+    # cold oracles: the parity reference every leg compares against
+    scorer_a = GameScorer(model_a, batch_rows=BATCH_ROWS)
+    scorer_b = GameScorer(model_b, batch_rows=BATCH_ROWS)
+    exp_a = [scorer_a.score_data(c) for c in chunks]
+    exp_b = [scorer_b.score_data(c) for c in chunks]
+
+    staging = os.path.join(work, "staging")
+    max_num = 240
+    for seq in range(1, max_num + 1):
+        spool.write_request(
+            staging,
+            seq,
+            chunks[(seq - 1) % num_chunks],
+            tenant="default",
+            deadline_s=DEADLINE_S,
+            arrival_wall=0.0,  # the producer patches in the schedule
+        )
+    print(
+        f"[serve-chaos] fixtures: {num_chunks} chunks x {ROWS_PER_REQ} rows, "
+        f"A={fp_a[:16]} B={fp_b[:16]}, {max_num} staged envelopes"
+    )
+    return {
+        "staging": staging,
+        "model_a_dir": model_a_dir,
+        "model_b_dir": model_b_dir,
+        "fp_a": fp_a,
+        "fp_b": fp_b,
+        "exp_a": exp_a,
+        "exp_b": exp_b,
+        "num_chunks": num_chunks,
+    }
+
+
+# -- server + burn-plane helpers --------------------------------------------
+
+
+def start_server(
+    out_root: str,
+    spool_dir: str,
+    *,
+    port: int,
+    models: list[tuple[str, str]] = (),
+    resume: bool = False,
+    faults: str | None = None,
+    log_path: str,
+) -> subprocess.Popen:
+    # ambient repo knobs pinned out: an exported PHOTON_* would change
+    # batch shape, SLO spec, or fault plan under the leg's feet
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith("PHOTON_") and k != "XLA_FLAGS"
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PHOTON_OBS_HTTP_PORT"] = str(port)
+    env["PHOTON_OBS_FLUSH_S"] = "1"
+    env["PHOTON_SLO_SPEC"] = SLO_SPEC
+    if faults:
+        env["PHOTON_FAULTS"] = faults
+    cmd = [
+        sys.executable, "-m", "photon_tpu.cli.game_serving",
+        "--root-output-directory", out_root,
+        "--spool-directory", spool_dir,
+        "--feature-shard-configurations", SHARD_ARG,
+        "--score-batch-rows", str(BATCH_ROWS),
+        # chaos legs measure lateness, not overflow: the cap is raised so
+        # a post-crash backlog is admitted whole (tests/test_serve.py
+        # owns the bounded-overload contract at the default cap)
+        "--queue-cap", "512",
+        "--default-deadline-s", str(DEADLINE_S),
+        "--poll-s", "0.02",
+    ]
+    if resume:
+        cmd.append("--resume")
+    for tenant, model_dir in models:
+        cmd += ["--model", f"{tenant}={model_dir}"]
+    print(f"[serve-chaos] server: {' '.join(cmd)}")
+    if faults:
+        print(f"[serve-chaos]   PHOTON_FAULTS={faults}")
+    return subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=open(log_path, "a"), stderr=subprocess.STDOUT,
+    )
+
+
+def wait_ready(port: int, proc: subprocess.Popen, log_path: str,
+               deadline_s: float = 180.0) -> None:
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            die(f"server exited rc={proc.returncode} before /healthz "
+                "answered", log_path)
+        try:
+            hz = json.loads(get(base + "/healthz", timeout=2.0))
+            if hz.get("status") in ("ok", "diverged"):
+                return
+        except (urllib.error.URLError, ConnectionError, OSError, ValueError):
+            pass
+        time.sleep(0.2)
+    die("server /healthz never became reachable", log_path)
+
+
+class BurnMonitor(threading.Thread):
+    """Poll ``/slo`` in the background, tolerating server downtime (the
+    SIGKILL leg); keeps every sample so the leg can assert the full
+    excursion-then-recovery shape afterwards."""
+
+    def __init__(self, port: int, interval: float = 0.25):
+        super().__init__(daemon=True)
+        self.url = f"http://127.0.0.1:{port}/slo"
+        self.interval = interval
+        self.samples: list[tuple[float, list[float], int]] = []
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                doc = json.loads(get(self.url, timeout=2.0))
+                burn = doc.get("burn_rates") or {}
+                rates = [
+                    float(b["rate"])
+                    for b in burn.values()
+                    if b.get("rate") is not None
+                ]
+                batches = sum(int(b.get("batches") or 0) for b in burn.values())
+                # phl-ok: PHL006 epoch anchor — request birth stamp aged across processes
+                self.samples.append((time.time(), rates, batches))
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    ValueError, KeyError):
+                pass
+            self._halt.wait(self.interval)
+
+    def halt(self) -> None:
+        self._halt.set()
+        self.join(timeout=5.0)
+
+    def assert_excursion_and_recovery(self, label: str, *logs: str) -> None:
+        last_hot_t, peak = None, 0.0
+        for t, rates, _ in self.samples:
+            if rates and max(rates) > 1.0:
+                last_hot_t = t
+                peak = max(peak, max(rates))
+        if last_hot_t is None:
+            die(f"{label}: burn rate never exceeded 1.0 across "
+                f"{len(self.samples)} samples (peak {peak:.3f})", *logs)
+        recovered = any(
+            t > last_hot_t and rates and max(rates) < 1.0 and batches > 0
+            for t, rates, batches in self.samples
+        )
+        if not recovered:
+            die(f"{label}: burn never recovered below 1.0 after the "
+                f"excursion (peak {peak:.1f})", *logs)
+        print(
+            f"[serve-chaos] {label}: burn excursion peak {peak:.1f}, "
+            "recovered < 1.0 under traffic"
+        )
+
+
+# -- result collection ------------------------------------------------------
+
+
+def emitted_seqs(spool_dir: str) -> set[int]:
+    if not os.path.isdir(spool_dir):
+        return set()
+    return {
+        int(m.group(1))
+        for n in os.listdir(spool_dir)
+        if (m := _SEQ_RE.match(n))
+    }
+
+
+def count_results(spool_dir: str) -> int:
+    if not os.path.isdir(spool_dir):
+        return 0
+    return sum(
+        1 for n in os.listdir(spool_dir)
+        if n.startswith("res-") and n.endswith(".npz")
+    )
+
+
+def wait_results(spool_dir: str, num: int, *, proc: subprocess.Popen,
+                 log_path: str, deadline_s: float = 300.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if count_results(spool_dir) >= num:
+            return
+        if proc.poll() is not None:
+            die(f"server exited rc={proc.returncode} with only "
+                f"{count_results(spool_dir)}/{num} results", log_path)
+        time.sleep(0.2)
+    die(f"only {count_results(spool_dir)}/{num} results within "
+        f"{deadline_s:.0f}s", log_path)
+
+
+def collect_results(spool_dir: str, num: int) -> dict[int, dict]:
+    from photon_tpu.serve import spool
+
+    out = {}
+    for seq in range(1, num + 1):
+        path = spool.result_path(spool_dir, seq)
+        if not os.path.exists(path):
+            die(f"request {seq} was dropped: no result file")
+        out[seq] = spool.read_result(path)
+    return out
+
+
+def assert_all_scored(results: dict[int, dict], label: str) -> None:
+    errs = {s: r for s, r in results.items() if "scores" not in r}
+    if errs:
+        first = next(iter(errs.values()))
+        die(f"{label}: {len(errs)} request(s) answered with errors, e.g. "
+            f"{first.get('error_type')}: {first.get('error_message')}")
+
+
+def stop_server(proc: subprocess.Popen, spool_dir: str, out_root: str,
+                log_path: str) -> dict:
+    """Graceful drain via the spool stop file; returns the server's own
+    summary document (the zero-compile gate lives there)."""
+    from photon_tpu.serve import spool
+
+    spool.request_stop(spool_dir)
+    try:
+        rc = proc.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        die("server did not drain after the stop file", log_path)
+    if rc != 0:
+        die(f"server exited rc={rc} on graceful stop", log_path)
+    with open(os.path.join(out_root, "serve-summary.json")) as f:
+        return json.load(f)
+
+
+def assert_compile_gate(summary: dict, label: str) -> None:
+    compiles = (summary.get("compiles") or {}).get("backend_compiles", -1)
+    swap_builds = summary.get("swap_build_compiles", 0)
+    if compiles != swap_builds:
+        die(f"{label}: traffic-time compiles detected — backend_compiles="
+            f"{compiles} but swap builds account for {swap_builds}")
+    print(
+        f"[serve-chaos] {label}: AOT gate ok "
+        f"(backend_compiles={compiles}, all swap builds)"
+    )
+
+
+# -- the legs ---------------------------------------------------------------
+
+
+def leg_producer_kill(fx: dict, work: str) -> None:
+    label = "leg1 producer-kill"
+    num, seed = 200, 3
+    spool_dir = os.path.join(work, "leg1", "spool")
+    out_root = os.path.join(work, "leg1", "serve")
+    slog = os.path.join(work, "leg1", "server.out")
+    plog = os.path.join(work, "leg1", "producer.out")
+    os.makedirs(os.path.join(work, "leg1"), exist_ok=True)
+    port = free_port()
+
+    server = start_server(
+        out_root, spool_dir, port=port,
+        models=[("default", fx["model_a_dir"])], log_path=slog,
+    )
+    mon = BurnMonitor(port)
+    try:
+        wait_ready(port, server, slog)
+        mon.start()
+        # phl-ok: PHL006 epoch anchor — wall deadline spanning the stalled swap window
+        t0 = time.time() + 2.0
+        prod = start_producer(
+            fx["staging"], spool_dir, num=num, qps=QPS, seed=seed, t0=t0,
+            log_path=plog,
+        )
+        # let traffic establish, then kill the source mid-schedule
+        while len(emitted_seqs(spool_dir)) < 40:
+            if prod.poll() is not None:
+                die(f"{label}: producer exited early rc={prod.returncode}",
+                    plog, slog)
+            time.sleep(0.1)
+        os.kill(prod.pid, signal.SIGKILL)
+        prod.wait()
+        last_seq = max(emitted_seqs(spool_dir))
+        print(f"[serve-chaos] {label}: producer SIGKILLed after seq "
+              f"{last_seq}; relaunching on the same schedule in 4s")
+        time.sleep(4.0)
+        prod2 = start_producer(
+            fx["staging"], spool_dir, num=num, qps=QPS, seed=seed, t0=t0,
+            start_seq=last_seq + 1, log_path=plog,
+        )
+        wait_results(spool_dir, num, proc=server, log_path=slog)
+        if prod2.wait(timeout=30) != 0:
+            die(f"{label}: relaunched producer failed rc={prod2.returncode}",
+                plog)
+
+        # satellite: the serve poll mode of the live probe must call the
+        # recovered plane healthy (burn back under the gate)
+        probe = subprocess.run(
+            [
+                sys.executable, os.path.join(REPO, "scripts", "live_probe.py"),
+                "--serve", f"http://127.0.0.1:{port}",
+                "--polls", "4", "--interval", "0.5",
+            ],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        print(probe.stdout[-1500:])
+        if probe.returncode != 0:
+            die(f"{label}: live_probe --serve rc={probe.returncode}:\n"
+                f"{probe.stderr[-2000:]}", slog)
+    finally:
+        mon.halt()
+        for p in (locals().get("prod"), locals().get("prod2")):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+    mon.assert_excursion_and_recovery(label, slog)
+    summary = stop_server(server, spool_dir, out_root, slog)
+    if summary.get("answered") != num:
+        die(f"{label}: answered {summary.get('answered')} != {num}", slog)
+    if summary.get("shed") != 0:
+        die(f"{label}: expected zero sheds, got {summary.get('shed')}", slog)
+    assert_compile_gate(summary, label)
+    results = collect_results(spool_dir, num)
+    assert_all_scored(results, label)
+    for seq, r in results.items():
+        exp = fx["exp_a"][(seq - 1) % fx["num_chunks"]]
+        if not np.array_equal(r["scores"], exp):
+            die(f"{label}: request {seq} scores diverge from the cold "
+                f"scorer (max |d|="
+                f"{np.max(np.abs(r['scores'] - exp)):.3e})")
+    print(f"[serve-chaos] {label}: GREEN — {num} answered, 0 shed, "
+          "bit parity on every request")
+
+
+def leg_swap_stall(fx: dict, work: str) -> None:
+    from photon_tpu.serve import spool
+
+    label = "leg2 swap-stall"
+    num, seed = 160, 5
+    spool_dir = os.path.join(work, "leg2", "spool")
+    out_root = os.path.join(work, "leg2", "serve")
+    slog = os.path.join(work, "leg2", "server.out")
+    plog = os.path.join(work, "leg2", "producer.out")
+    os.makedirs(os.path.join(work, "leg2"), exist_ok=True)
+    port = free_port()
+    base = f"http://127.0.0.1:{port}"
+    done_path = os.path.join(spool_dir, "swap-default.done.json")
+
+    server = start_server(
+        out_root, spool_dir, port=port,
+        models=[("default", fx["model_a_dir"])],
+        faults="serve.swap@1=stall:3", log_path=slog,
+    )
+    mon = BurnMonitor(port)
+    try:
+        wait_ready(port, server, slog)
+        mon.start()
+        # phl-ok: PHL006 epoch anchor — wall deadline spanning a server SIGKILL + relaunch
+        t0 = time.time() + 2.0
+        prod = start_producer(
+            fx["staging"], spool_dir, num=num, qps=QPS, seed=seed, t0=t0,
+            log_path=plog,
+        )
+        while count_results(spool_dir) < 25:
+            if server.poll() is not None:
+                die(f"{label}: server died warming up", slog)
+            time.sleep(0.1)
+
+        # 2a: a swap pinned to the WRONG fingerprint must roll back
+        # without touching the active model or dropping a request
+        spool.write_swap_command(
+            spool_dir, "default", fx["model_b_dir"],
+            expect_fingerprint="0" * 64,
+        )
+        deadline = time.monotonic() + 60
+        while not os.path.exists(done_path):
+            if time.monotonic() > deadline:
+                die(f"{label}: rollback outcome never published", slog)
+            time.sleep(0.1)
+        with open(done_path) as f:
+            outcome = json.load(f)
+        if outcome.get("status") != "rolled_back":
+            die(f"{label}: bad-fingerprint swap was not rolled back: "
+                f"{outcome}", slog)
+        os.remove(done_path)
+        hz = json.loads(get(base + "/healthz"))
+        if hz["recovery"]["failures"].get("rollback", 0) < 1:
+            die(f"{label}: rollback not classified on the recovery spine: "
+                f"{hz['recovery']}", slog)
+        if hz.get("serve", {}).get("swap_rollbacks", 0) < 1:
+            die(f"{label}: serve.swap_rollbacks counter missing: "
+                f"{hz.get('serve')}", slog)
+        print(f"[serve-chaos] {label}: bad-fingerprint swap rolled back "
+              "(recovery.failures.rollback counted), serving undisturbed")
+
+        # 2b: the real swap — the fault plan stalls the atomic flip 3s,
+        # holding the critical section open under live traffic
+        spool.write_swap_command(
+            spool_dir, "default", fx["model_b_dir"],
+            expect_fingerprint=fx["fp_b"],
+        )
+        deadline = time.monotonic() + 120
+        while not os.path.exists(done_path):
+            if server.poll() is not None:
+                die(f"{label}: server died during the swap", slog)
+            if time.monotonic() > deadline:
+                die(f"{label}: swap outcome never published", slog)
+            time.sleep(0.1)
+        # phl-ok: PHL006 epoch anchor — compared against the producer's wall-clock schedule to find definitely-post-flip requests
+        t_applied_seen = time.time()
+        with open(done_path) as f:
+            outcome = json.load(f)
+        if outcome.get("status") != "applied":
+            die(f"{label}: swap not applied: {outcome}", slog)
+        if outcome.get("fingerprint") != fx["fp_b"]:
+            die(f"{label}: applied fingerprint mismatch: {outcome}", slog)
+        print(f"[serve-chaos] {label}: swap applied through the stalled "
+              f"flip (build {outcome.get('build_wall_s'):.2f}s)")
+
+        wait_results(spool_dir, num, proc=server, log_path=slog)
+        if prod.wait(timeout=60) != 0:
+            die(f"{label}: producer failed rc={prod.returncode}", plog)
+    finally:
+        mon.halt()
+        p = locals().get("prod")
+        if p is not None and p.poll() is None:
+            p.kill()
+
+    mon.assert_excursion_and_recovery(label, slog)
+    summary = stop_server(server, spool_dir, out_root, slog)
+    if summary.get("answered") != num:
+        die(f"{label}: answered {summary.get('answered')} != {num} "
+            "(a hot swap must not fail or drop a request)", slog)
+    if summary.get("swap_build_compiles", 0) < 1:
+        die(f"{label}: swap build compiled nothing?", slog)
+    assert_compile_gate(summary, label)
+    reg = summary.get("registry", {}).get("default", {})
+    if reg.get("swaps") != 1:
+        die(f"{label}: registry records {reg.get('swaps')} swaps, want 1")
+
+    results = collect_results(spool_dir, num)
+    assert_all_scored(results, label)
+    offsets = arrival_offsets(QPS, num, seed)
+    post_flip = 0
+    for seq, r in results.items():
+        exp_a = fx["exp_a"][(seq - 1) % fx["num_chunks"]]
+        exp_b = fx["exp_b"][(seq - 1) % fx["num_chunks"]]
+        is_a = np.array_equal(r["scores"], exp_a)
+        is_b = np.array_equal(r["scores"], exp_b)
+        if not (is_a or is_b):
+            die(f"{label}: request {seq} matches NEITHER model — a torn "
+                "swap leaked mixed tables")
+        # written after the applied outcome was published => admitted,
+        # dispatched, and answered on the NEW tables, bit-exact
+        if t0 + float(offsets[seq - 1]) > t_applied_seen:
+            post_flip += 1
+            if not is_b:
+                die(f"{label}: post-swap request {seq} answered by the "
+                    "OLD model")
+    if post_flip < 10:
+        die(f"{label}: only {post_flip} post-flip requests — the leg "
+            "did not exercise the swapped model under traffic")
+    print(f"[serve-chaos] {label}: GREEN — {num} answered, 0 failed, "
+          f"{post_flip} post-flip answers bit-match the new model")
+
+
+def leg_server_kill(fx: dict, work: str) -> None:
+    label = "leg3 server-kill"
+    num, seed = 240, 7
+    spool_dir = os.path.join(work, "leg3", "spool")
+    out_root = os.path.join(work, "leg3", "serve")
+    slog = os.path.join(work, "leg3", "server.out")
+    plog = os.path.join(work, "leg3", "producer.out")
+    os.makedirs(os.path.join(work, "leg3"), exist_ok=True)
+    port = free_port()
+
+    server = start_server(
+        out_root, spool_dir, port=port,
+        models=[("default", fx["model_a_dir"])],
+        faults="serve.dispatch@25=kill", log_path=slog,
+    )
+    mon = BurnMonitor(port)
+    server2 = None
+    try:
+        wait_ready(port, server, slog)
+        mon.start()
+        # phl-ok: PHL006 epoch anchor — the shared wall-clock schedule origin both producer incarnations pace against
+        t0 = time.time() + 2.0
+        prod = start_producer(
+            fx["staging"], spool_dir, num=num, qps=QPS, seed=seed, t0=t0,
+            log_path=plog,
+        )
+        # the 25th dispatch SIGKILLs the server from inside the batch
+        try:
+            rc = server.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            die(f"{label}: server survived the kill fault", slog)
+        if rc == 0:
+            die(f"{label}: server exited CLEAN under a kill fault", slog)
+        answered_before = count_results(spool_dir)
+        print(f"[serve-chaos] {label}: server SIGKILLed (rc={rc}) after "
+              f"{answered_before} answers; producer still writing")
+        time.sleep(1.0)
+
+        # relaunch: same output root, faults cleared — the registry
+        # manifest restores the tenant, the spool restores the backlog
+        server2 = start_server(
+            out_root, spool_dir, port=port, resume=True, log_path=slog,
+        )
+        wait_ready(port, server2, slog)
+        wait_results(spool_dir, num, proc=server2, log_path=slog)
+        if prod.wait(timeout=120) != 0:
+            die(f"{label}: producer failed rc={prod.returncode}", plog)
+    finally:
+        mon.halt()
+        p = locals().get("prod")
+        if p is not None and p.poll() is None:
+            p.kill()
+        if server.poll() is None:
+            server.kill()
+
+    mon.assert_excursion_and_recovery(label, slog)
+    summary = stop_server(server2, spool_dir, out_root, slog)
+    reg = summary.get("registry", {}).get("default", {})
+    if reg.get("fingerprint") != fx["fp_a"][:16]:
+        die(f"{label}: relaunch did not reload the manifest model: {reg}")
+    assert_compile_gate(summary, label)
+    results = collect_results(spool_dir, num)
+    assert_all_scored(results, label)
+    for seq, r in results.items():
+        exp = fx["exp_a"][(seq - 1) % fx["num_chunks"]]
+        if not np.array_equal(r["scores"], exp):
+            die(f"{label}: request {seq} scores diverge after the "
+                "relaunch")
+    print(f"[serve-chaos] {label}: GREEN — every one of {num} requests "
+          "answered across the SIGKILL, bit parity on all")
+
+
+# -- entry ------------------------------------------------------------------
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--n", type=int, default=400)
+    ap.add_argument(
+        "--leg", choices=["1", "2", "3", "all"], default="all",
+        help="run one leg (fixtures always build)",
+    )
+    # the producer subcommand (internal; spawned by the legs)
+    ap.add_argument("--producer", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--staging", help=argparse.SUPPRESS)
+    ap.add_argument("--spool", help=argparse.SUPPRESS)
+    ap.add_argument("--num", type=int, help=argparse.SUPPRESS)
+    ap.add_argument("--qps", type=float, help=argparse.SUPPRESS)
+    ap.add_argument("--seed", type=int, help=argparse.SUPPRESS)
+    ap.add_argument("--t0", type=float, help=argparse.SUPPRESS)
+    ap.add_argument("--start-seq", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.producer:
+        return run_producer(args)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    work = args.workdir or tempfile.mkdtemp(prefix="photon-serve-chaos-")
+    os.makedirs(work, exist_ok=True)
+    print(f"[serve-chaos] workdir: {work}")
+
+    fx = build_fixtures(work, args.n)
+    if args.leg in ("1", "all"):
+        leg_producer_kill(fx, work)
+    if args.leg in ("2", "all"):
+        leg_swap_stall(fx, work)
+    if args.leg in ("3", "all"):
+        leg_server_kill(fx, work)
+    print("[serve-chaos] ALL LEGS GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -191,3 +191,29 @@ def test_mesh_scaling_band_semantics():
         "glmix_game_estimator",
         dict(base, mesh=dict(healthy_mesh, fleet={"error": "leg timed out"})),
     )
+
+
+def test_serving_swap_band_semantics():
+    """The hot-swap bands (ISSUE 16): zero failed/shed requests and
+    post-flip bit parity vs the new model's cold scorer. A row missing
+    its swap record or with no post-flip answers measured nothing and
+    must fail too."""
+    healthy = {
+        "swap": {"swap_wall_s": 0.1, "in_flight_at_flip": 2},
+        "failed_requests": 0,
+        "shed": 0,
+        "post_flip_requests": 12,
+        "post_swap_parity_max_abs": 0.0,
+    }
+    assert bench.check_quality_bands("game_serving_swap", healthy) == []
+    for poison, needle in (
+        ({"failed_requests": 1}, "zero-downtime claim broken"),
+        ({"shed": 3}, "shed"),
+        ({"post_swap_parity_max_abs": 1e-3}, "parity"),
+        ({"post_swap_parity_max_abs": float("nan")}, "parity"),
+        ({"post_flip_requests": 0}, "measured nothing"),
+        ({"swap": None}, "no swap record"),
+    ):
+        detail = dict(healthy, **poison)
+        violations = bench.check_quality_bands("game_serving_swap", detail)
+        assert any(needle in v for v in violations), (poison, violations)

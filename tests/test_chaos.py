@@ -686,6 +686,47 @@ def test_classify_failure_taxonomy():
     )
 
 
+def test_classify_failure_serving_kinds():
+    from photon_tpu.serve.admission import AdmissionRejected, DeadlineExceeded
+    from photon_tpu.serve.registry import SwapValidationError
+
+    assert classify_failure(AdmissionRejected("queue_full")) == "load_shed"
+    assert classify_failure(DeadlineExceeded("expired")) == "load_shed"
+    assert (
+        classify_failure(SwapValidationError("fingerprints differ"))
+        == "rollback"
+    )
+
+
+def test_run_with_recovery_never_spends_fuel_on_serving_kinds():
+    """A shed or a rolled-back swap is the system WORKING, not failing:
+    re-raise with the counter bumped, restart budget untouched."""
+    from photon_tpu.serve.admission import AdmissionRejected
+    from photon_tpu.serve.registry import SwapValidationError
+
+    obs.enable()
+    obs.reset()
+    try:
+        for exc, kind in (
+            (AdmissionRejected("queue_full"), "load_shed"),
+            (SwapValidationError("torn checkpoint"), "rollback"),
+        ):
+            calls = {"n": 0}
+
+            def once(exc=exc):
+                calls["n"] += 1
+                raise exc
+
+            with pytest.raises(type(exc)):
+                run_with_recovery(once, max_restarts=5, sleep=lambda s: None)
+            assert calls["n"] == 1  # no restart granted
+            assert _counters().get(f"recovery.failures.{kind}") == 1
+        assert _counters().get("recovery.restarts") is None
+    finally:
+        obs.disable()
+        obs.reset()
+
+
 def test_run_with_recovery_restarts_transients_and_counts():
     calls = {"n": 0}
 

@@ -611,3 +611,53 @@ def test_unsupported_layout_error_is_distinct():
     with pytest.raises(ValueError) as ei:
         GameScorer(model, batch_rows=0)
     assert not isinstance(ei.value, UnsupportedModelLayout)
+
+
+def test_partial_run_percentiles_cover_answered_only():
+    """A report from a sheddy run must not masquerade as a full one:
+    percentiles describe answered work, and ``count``/``shed`` ride
+    along so the reader can tell how much work that was."""
+    from photon_tpu.game.scoring import StreamStats
+
+    stats = StreamStats()
+    stats.e2e_walls_s = [0.010, 0.020, 0.030, 0.040]
+    stats.shed = 6  # 6 of 10 requests answered with a typed rejection
+    pcts = stats.e2e_percentiles()
+    assert pcts["count"] == 4
+    assert pcts["shed"] == 6
+    assert pcts["p50"] == pytest.approx(0.025)
+    assert pcts["max"] == pytest.approx(0.040)
+    # shed requests contributed no walls: p99 reflects the 4 answers
+    assert pcts["p99"] <= 0.040
+
+
+def test_everything_shed_report_is_not_empty():
+    """All-shed is the degenerate partial run: no walls at all, but the
+    report still says what happened instead of returning {}."""
+    from photon_tpu.game.scoring import StreamStats
+
+    stats = StreamStats()
+    stats.shed = 9
+    assert stats.e2e_percentiles() == {"count": 0, "shed": 9}
+    # while a genuinely-empty run (nothing submitted) stays empty
+    assert StreamStats().e2e_percentiles() == {}
+
+
+def test_stage_percentiles_on_partial_stage_lists():
+    """Mid-run interruption leaves ragged stage lists (a batch that died
+    after h2d recorded no dispatch wall): each stage reports over what
+    it measured, and silent stages are omitted rather than zero-filled."""
+    from photon_tpu.game.scoring import StreamStats
+
+    stats = StreamStats()
+    stats.stage_walls_s = {
+        "h2d": [0.001, 0.002, 0.003],
+        "dispatch": [0.005, 0.007],  # third batch never dispatched
+        "readback": [],  # and nothing read back after the fault
+    }
+    waterfall = stats.stage_percentiles()
+    assert set(waterfall) == {"h2d", "dispatch"}
+    assert waterfall["h2d"]["p50"] == pytest.approx(0.002)
+    assert waterfall["dispatch"]["p99"] == pytest.approx(
+        float(np.percentile(np.asarray([0.005, 0.007]), 99))
+    )

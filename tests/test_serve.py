@@ -1,0 +1,530 @@
+"""Serving engine (ISSUE 16): bounded admission + typed load shedding,
+the multi-tenant registry's pricing/lease/hot-swap machinery, the
+persistent engine's end-to-end parity and zero-traffic-compile gate,
+the spool transport's at-least-once envelope discipline, and the
+2x-overload contract (queue pinned at cap, synchronous typed sheds)."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu import obs
+from photon_tpu.game.data import GameData, slice_game_data
+from photon_tpu.serve import spool
+from photon_tpu.serve.admission import (
+    AdmissionQueue,
+    AdmissionRejected,
+    DeadlineExceeded,
+    ServeFuture,
+    serve_deadline_s,
+    serve_queue_cap,
+)
+from photon_tpu.serve.registry import (
+    ModelRegistry,
+    ServeMemoryBudgetError,
+    SwapValidationError,
+    model_fingerprint,
+    serve_mem_budget_bytes,
+)
+from photon_tpu.util import faults
+
+SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in (
+        "PHOTON_SERVE_QUEUE_CAP",
+        "PHOTON_SERVE_DEADLINE_S",
+        "PHOTON_SERVE_MEM_BYTES",
+        "PHOTON_SLO_SPEC",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    faults.clear()
+    yield
+    faults.clear()
+    obs.reset()
+    obs.disable()
+
+
+def _counters():
+    return obs.get_registry().snapshot()["counters"]
+
+
+def _chunk(rows: int = 4, seed: int = 0) -> GameData:
+    """A tiny featureless GameData (offsets carry the signal, so scores
+    are deterministic without any model table lookups)."""
+    rng = np.random.default_rng(seed)
+    return GameData.build(
+        labels=np.zeros(rows),
+        offsets=rng.normal(size=rows),
+        feature_shards={},
+        id_tags={},
+    )
+
+
+def _workload(seed: int = 0, num_requests: int = 6, batch_rows: int = 32):
+    import load_harness
+
+    return load_harness.build_workload(
+        num_requests=num_requests,
+        batch_rows=batch_rows,
+        d=8,
+        nnz=4,
+        users=8,
+        items=4,
+        seed=seed,
+    )
+
+
+# -- knobs ------------------------------------------------------------------
+
+
+def test_serve_knobs_env_wins_and_bad_values_raise(monkeypatch):
+    assert serve_queue_cap() == 64
+    assert serve_queue_cap(10) == 10
+    monkeypatch.setenv("PHOTON_SERVE_QUEUE_CAP", "7")
+    assert serve_queue_cap(10) == 7
+    monkeypatch.setenv("PHOTON_SERVE_QUEUE_CAP", "0")
+    with pytest.raises(ValueError):
+        serve_queue_cap()
+
+    monkeypatch.delenv("PHOTON_SERVE_QUEUE_CAP")
+    assert serve_deadline_s() == 30.0
+    monkeypatch.setenv("PHOTON_SERVE_DEADLINE_S", "2.5")
+    assert serve_deadline_s(9.0) == 2.5
+    monkeypatch.setenv("PHOTON_SERVE_DEADLINE_S", "-1")
+    with pytest.raises(ValueError):
+        serve_deadline_s()
+
+    monkeypatch.delenv("PHOTON_SERVE_DEADLINE_S")
+    assert serve_mem_budget_bytes() is None
+    monkeypatch.setenv("PHOTON_SERVE_MEM_BYTES", "1024")
+    assert serve_mem_budget_bytes(4) == 1024
+    monkeypatch.setenv("PHOTON_SERVE_MEM_BYTES", "0")
+    with pytest.raises(ValueError):
+        serve_mem_budget_bytes()
+
+
+def test_serve_future_timeout_and_exception():
+    fut = ServeFuture()
+    with pytest.raises(TimeoutError):
+        fut.result(timeout=0.01)
+    fut.set_exception(DeadlineExceeded("too late"))
+    assert fut.done()
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=0)
+
+    ok = ServeFuture()
+    ok.set_result(np.arange(3))
+    assert ok.exception() is None
+    np.testing.assert_array_equal(ok.result(timeout=0), np.arange(3))
+
+
+# -- admission + shedding ---------------------------------------------------
+
+
+def test_admission_sheds_are_typed_and_counted():
+    obs.enable()
+    q = AdmissionQueue(cap=2, default_deadline_s=30.0, max_rows=8)
+
+    with pytest.raises(AdmissionRejected):
+        q.submit(_chunk(rows=9))  # oversize: can never fit a batch
+
+    # born already dead: scheduled arrival far in the past
+    with pytest.raises(DeadlineExceeded):
+        q.submit(_chunk(), arrival_t=time.perf_counter() - 5.0, deadline_s=1.0)
+
+    q.submit(_chunk())
+    q.submit(_chunk())
+    with pytest.raises(AdmissionRejected):
+        q.submit(_chunk())  # queue_full at cap
+
+    q.close()
+    with pytest.raises(AdmissionRejected):
+        q.submit(_chunk())  # closed
+
+    assert q.shed_count == 4
+    c = _counters()
+    assert c.get("serve.shed") == 4
+    assert c.get("serve.shed.oversize") == 1
+    assert c.get("serve.shed.deadline") == 1
+    assert c.get("serve.shed.queue_full") == 1
+    assert c.get("serve.shed.closed") == 1
+    assert c.get("serve.shed.tenant.default") == 4
+    assert c.get("serve.admitted") == 2
+
+
+def test_overload_2x_queue_pinned_at_cap_with_synchronous_rejections():
+    """The bounded-overload acceptance shape: at 2x what the queue can
+    hold, every overflow submit is rejected INSIDE the caller's own
+    submit call (typed, immediate — well within any deadline budget)
+    and the queue depth never exceeds the cap."""
+    obs.enable()
+    cap = 8
+    q = AdmissionQueue(cap=cap, default_deadline_s=30.0, max_rows=64)
+    admitted, rejected = 0, 0
+    for i in range(2 * cap):
+        t0 = time.perf_counter()
+        try:
+            q.submit(_chunk(seed=i))
+            admitted += 1
+        except AdmissionRejected:
+            rejected += 1
+            # the shed answer arrived synchronously, not after a queue wait
+            assert time.perf_counter() - t0 < 1.0
+        assert q.depth() <= cap
+    assert admitted == cap
+    assert rejected == cap
+    assert q.depth() == cap
+    assert _counters().get("serve.shed.queue_full") == cap
+
+
+def test_next_batch_packs_same_tenant_within_max_rows():
+    q = AdmissionQueue(cap=16, default_deadline_s=30.0, max_rows=16)
+    q.submit(_chunk(rows=6), tenant="a")
+    q.submit(_chunk(rows=6), tenant="a")
+    q.submit(_chunk(rows=6), tenant="b")
+    q.submit(_chunk(rows=4), tenant="a")
+
+    batch = q.next_batch(max_rows=16, timeout=0.1)
+    # head (a,6) + (a,6) + (a,4) = 16 rows; the b request is skipped, not lost
+    assert [r.tenant for r in batch] == ["a", "a", "a"]
+    assert sum(r.chunk.num_samples for r in batch) == 16
+    batch2 = q.next_batch(max_rows=16, timeout=0.1)
+    assert [r.tenant for r in batch2] == ["b"]
+    assert q.next_batch(max_rows=16, timeout=0.05) is None  # timeout tick
+    q.close()
+    assert q.next_batch(max_rows=16, timeout=0.05) == []  # drained + closed
+
+
+def test_next_batch_sheds_expired_requests_at_dequeue():
+    obs.enable()
+    q = AdmissionQueue(cap=8, default_deadline_s=30.0, max_rows=16)
+    dead = q.submit(_chunk(), deadline_s=0.01)
+    live = q.submit(_chunk(), deadline_s=30.0)
+    time.sleep(0.05)
+    batch = q.next_batch(max_rows=16, timeout=0.1)
+    assert len(batch) == 1 and batch[0].future is live is not dead
+    assert dead.done()
+    with pytest.raises(DeadlineExceeded):
+        dead.result(timeout=0)
+    assert _counters().get("serve.shed.deadline") == 1
+    assert q.shed_count == 1
+
+
+# -- registry: pricing, leases, hot swap ------------------------------------
+
+
+def test_registry_register_prices_and_rejects_duplicates():
+    scorer, _ = _workload()
+    reg = ModelRegistry()
+    info = reg.register("t1", scorer.model, batch_rows=32)
+    assert info["table_bytes"] > 0
+    assert info["fingerprint"] == model_fingerprint(scorer.model)
+    assert reg.tenants() == ["t1"]
+    with pytest.raises(ValueError, match="begin_swap"):
+        reg.register("t1", scorer.model, batch_rows=32)
+
+
+def test_registry_memory_budget_refuses_loudly():
+    scorer, _ = _workload()
+    reg = ModelRegistry(mem_budget_bytes=1)
+    with pytest.raises(ServeMemoryBudgetError, match="PHOTON_SERVE_MEM_BYTES"):
+        reg.register("t1", scorer.model, batch_rows=32)
+    assert reg.tenants() == []
+
+
+def test_registry_leases_and_drain_evict():
+    obs.enable()
+    scorer_a, _ = _workload(seed=0)
+    scorer_b, _ = _workload(seed=1)
+    reg = ModelRegistry()
+    reg.register("t", scorer_a.model, batch_rows=32)
+
+    old = reg.acquire("t")
+    assert reg.in_flight("t") == 1
+    reg.begin_swap("t", scorer_b.model, batch_rows=32)
+    assert reg.has_pending_swap("t")
+    assert reg.apply_pending_swap("t")
+    # the in-flight lease pins the old buffer: not evicted yet
+    assert _counters().get("serve.evicted") is None
+    assert reg.snapshot()["t"]["draining"] == 1
+    # post-flip acquire hands out the NEW scorer while the old drains
+    fresh = reg.acquire("t")
+    assert fresh is not old
+    reg.release("t", fresh)
+    reg.release("t", old)  # last old lease retires -> tables freed
+    assert _counters().get("serve.evicted") == 1
+    assert reg.snapshot()["t"]["draining"] == 0
+    assert reg.snapshot()["t"]["swaps"] == 1
+
+
+def test_swap_validation_failures_roll_back():
+    obs.enable()
+    scorer_a, _ = _workload(seed=0)
+    scorer_b, _ = _workload(seed=1)
+    reg = ModelRegistry()
+    reg.register("t", scorer_a.model, batch_rows=32)
+    fp_before = reg.snapshot()["t"]["fingerprint"]
+
+    with pytest.raises(SwapValidationError, match="fingerprints"):
+        reg.begin_swap(
+            "t", scorer_b.model, expect_fingerprint="0" * 64, batch_rows=32
+        )
+
+    def torn_loader():
+        raise OSError("torn checkpoint mid-read")
+
+    with pytest.raises(SwapValidationError, match="torn checkpoint"):
+        reg.begin_swap("t", torn_loader, batch_rows=32)
+
+    assert not reg.has_pending_swap("t")
+    assert reg.snapshot()["t"]["fingerprint"] == fp_before
+    assert reg.snapshot()["t"]["swaps"] == 0
+    assert _counters().get("serve.swap_rollbacks") == 2
+
+
+def test_registry_manifest_roundtrip_and_torn_manifest_raises(tmp_path):
+    scorer, _ = _workload()
+    path = str(tmp_path / "registry.json")
+    reg = ModelRegistry(manifest_path=path)
+    reg.register("t", scorer.model, model_dir="/models/t/best", batch_rows=32)
+    doc = ModelRegistry.load_manifest(path)
+    assert doc["t"]["model_dir"] == "/models/t/best"
+    assert doc["t"]["fingerprint"] == model_fingerprint(scorer.model)
+
+    with open(path, "w") as f:
+        f.write('{"t": {"model_dir"')  # torn write
+    with pytest.raises(json.JSONDecodeError):
+        ModelRegistry.load_manifest(path)
+
+
+# -- the engine end-to-end --------------------------------------------------
+
+
+def _start_engine(reg, *, cap=64, batch_rows=32, poll_s=0.02):
+    from photon_tpu.serve.engine import ServingEngine
+
+    q = AdmissionQueue(cap=cap, default_deadline_s=30.0, max_rows=batch_rows)
+    engine = ServingEngine(reg, q, batch_rows=batch_rows, poll_s=poll_s)
+    engine.start()
+    return engine, q
+
+
+def test_engine_parity_zero_compiles_and_drain():
+    obs.enable()
+    scorer, chunks = _workload(seed=0, num_requests=4, batch_rows=32)
+    # the cold oracle runs BEFORE the traffic window so its compiles
+    # cannot pollute the engine's compile_watch delta
+    requests = [slice_game_data(c, 0, 10) for c in chunks]
+    expected = [scorer.score_data(r) for r in requests]
+
+    reg = ModelRegistry()
+    reg.register(
+        "default", scorer.model, batch_rows=32, ell_widths={"global": 4}
+    )
+    engine, q = _start_engine(reg, batch_rows=32)
+    futs = [q.submit(r) for r in requests]
+    stats = engine.stop()
+
+    for fut, exp in zip(futs, expected):
+        np.testing.assert_array_equal(fut.result(timeout=5), exp)
+    assert stats.samples == sum(r.num_samples for r in requests)
+    assert stats.shed == 0
+    # the hard AOT gate: zero backend compiles inside the traffic window
+    assert stats.compiles.get("backend_compiles") == 0
+    assert reg.swap_build_compiles == 0
+    summary = engine.summary()
+    assert summary["requests"] == len(requests)
+    assert summary["compiles"]["backend_compiles"] == 0
+
+
+def test_engine_hot_swap_under_load_answers_everything():
+    obs.enable()
+    scorer_a, chunks = _workload(seed=0, num_requests=6, batch_rows=32)
+    scorer_b, _ = _workload(seed=1, num_requests=6, batch_rows=32)
+    requests = [slice_game_data(c, 0, 8) for c in chunks]
+    exp_a = [scorer_a.score_data(r) for r in requests]
+    exp_b = [scorer_b.score_data(r) for r in requests]
+
+    reg = ModelRegistry()
+    reg.register(
+        "default", scorer_a.model, batch_rows=32, ell_widths={"global": 4}
+    )
+    engine, q = _start_engine(reg, batch_rows=32)
+
+    pre = [q.submit(r) for r in requests[:3]]
+    reg.begin_swap(
+        "default",
+        scorer_b.model,
+        expect_fingerprint=model_fingerprint(scorer_b.model),
+    )
+    deadline = time.perf_counter() + 10
+    while reg.has_pending_swap("default"):
+        assert time.perf_counter() < deadline, "engine never applied the flip"
+        time.sleep(0.005)
+    post = [q.submit(r) for r in requests[3:]]
+    stats = engine.stop()
+
+    # nothing failed, nothing dropped; pre-flip answers match A or B
+    # (a request admitted before the flip may dispatch after it), and
+    # every post-flip answer bit-matches the NEW model's cold scorer
+    for i, fut in enumerate(pre):
+        got = fut.result(timeout=5)
+        assert np.array_equal(got, exp_a[i]) or np.array_equal(got, exp_b[i])
+    for i, fut in enumerate(post, start=3):
+        np.testing.assert_array_equal(fut.result(timeout=5), exp_b[i])
+    assert stats.shed == 0
+    # every compile in the window is attributable to the swap build
+    assert stats.compiles.get("backend_compiles", 0) == (
+        reg.swap_build_compiles
+    )
+    assert engine.last_swap is not None
+    assert engine.last_swap["tenant"] == "default"
+    assert _counters().get("serve.swaps") == 1
+
+
+def test_engine_unknown_tenant_answered_not_wedged():
+    obs.enable()
+    scorer, chunks = _workload(seed=0, num_requests=2, batch_rows=32)
+    req = slice_game_data(chunks[0], 0, 6)
+    expected = scorer.score_data(req)
+
+    reg = ModelRegistry()
+    reg.register(
+        "default", scorer.model, batch_rows=32, ell_widths={"global": 4}
+    )
+    engine, q = _start_engine(reg, batch_rows=32)
+    ghost = q.submit(req, tenant="ghost")
+    good = q.submit(req, tenant="default")
+    engine.stop()
+
+    with pytest.raises(KeyError):
+        ghost.result(timeout=5)
+    np.testing.assert_array_equal(good.result(timeout=5), expected)
+    assert _counters().get("serve.dispatch_failures") == 1
+
+
+def test_engine_transient_dispatch_fault_retries_in_place():
+    obs.enable()
+    scorer, chunks = _workload(seed=0, num_requests=2, batch_rows=32)
+    req = slice_game_data(chunks[0], 0, 6)
+    expected = scorer.score_data(req)
+
+    reg = ModelRegistry()
+    reg.register(
+        "default", scorer.model, batch_rows=32, ell_widths={"global": 4}
+    )
+    with faults.injected("serve.dispatch@1=unavailable"):
+        engine, q = _start_engine(reg, batch_rows=32)
+        fut = q.submit(req)
+        stats = engine.stop()
+    np.testing.assert_array_equal(fut.result(timeout=5), expected)
+    assert stats.batch_retries >= 1
+
+
+# -- the spool transport ----------------------------------------------------
+
+
+def test_spool_request_roundtrip_and_result_retires_request(tmp_path):
+    _, chunks = _workload(seed=0, num_requests=2, batch_rows=32)
+    chunk = slice_game_data(chunks[0], 0, 5)
+    spool_dir = str(tmp_path / "spool")
+    path = spool.write_request(
+        spool_dir, 3, chunk, tenant="t", deadline_s=9.0, arrival_wall=123.5
+    )
+    assert spool.pending_requests(spool_dir) == [path]
+    assert spool.request_seq(path) == 3
+
+    back, meta = spool.read_request(path)
+    assert meta == {
+        "seq": 3, "tenant": "t", "deadline_s": 9.0, "arrival_wall": 123.5,
+    }
+    assert back.num_samples == chunk.num_samples
+    np.testing.assert_array_equal(back.labels, chunk.labels)
+    np.testing.assert_array_equal(back.offsets, chunk.offsets)
+    for name, m in chunk.feature_shards.items():
+        np.testing.assert_array_equal(
+            back.feature_shards[name].indptr, m.indptr
+        )
+        np.testing.assert_array_equal(
+            back.feature_shards[name].values, m.values
+        )
+    for tag, col in chunk.id_tags.items():
+        np.testing.assert_array_equal(
+            back.id_tags[tag], np.asarray(col, dtype=str)
+        )
+
+    # answering writes the result BEFORE retiring the request file
+    res = spool.write_result(spool_dir, 3, scores=np.arange(5.0))
+    assert not os.path.exists(path)
+    out = spool.read_result(res)
+    assert out["seq"] == 3
+    np.testing.assert_array_equal(out["scores"], np.arange(5.0))
+
+    err = spool.write_result(spool_dir, 4, error=DeadlineExceeded("late"))
+    out = spool.read_result(err)
+    assert out["error_type"] == "DeadlineExceeded"
+    assert "late" in out["error_message"]
+
+
+def test_spool_rebase_arrival_preserves_age():
+    age = 2.0
+    rebased = spool.rebase_arrival(time.time() - age)
+    assert time.perf_counter() - rebased == pytest.approx(age, abs=0.2)
+
+
+def test_spool_swap_command_and_stop_files(tmp_path):
+    d = str(tmp_path / "spool")
+    cmd_path = spool.write_swap_command(
+        d, "t", "/models/new", expect_fingerprint="abc"
+    )
+    cmds = spool.read_swap_command(d)
+    assert len(cmds) == 1
+    assert cmds[0]["model_dir"] == "/models/new"
+    assert cmds[0]["expect_fingerprint"] == "abc"
+    assert cmds[0]["_path"] == cmd_path
+
+    spool.write_swap_outcome(
+        d, "t", {"status": "applied"}, command_path=cmd_path
+    )
+    assert spool.read_swap_command(d) == []  # command retired
+    with open(os.path.join(d, "swap-t.done.json")) as f:
+        assert json.load(f)["status"] == "applied"
+
+    assert not spool.stop_requested(d)
+    spool.request_stop(d)
+    assert spool.stop_requested(d)
+
+
+# -- the serve probe's burn verdict -----------------------------------------
+
+
+def test_live_probe_sustained_burn_verdict():
+    import live_probe
+
+    hot = {"8s": {"rate": 5.0, "batches": 10}}
+    cold = {"8s": {"rate": 0.2, "batches": 10}}
+    idle = {"8s": {"rate": None, "batches": 0}}
+
+    bad, reason = live_probe.sustained_burn([hot, hot, hot], 1.0, 3)
+    assert bad and "3 consecutive" in reason
+    # an excursion that recovers is healthy — the chaos legs cause those
+    ok, _ = live_probe.sustained_burn([hot, hot, cold, hot], 1.0, 3)
+    assert not ok
+    # idle windows are not evidence of burn
+    ok, _ = live_probe.sustained_burn([idle, idle, idle], 1.0, 1)
+    assert not ok
+    bad, _ = live_probe.sustained_burn([cold, hot, hot], 1.0, 2)
+    assert bad
