@@ -43,8 +43,10 @@ from photon_tpu.cache.format import (
     source_file_fingerprint,
     tag_columns,
 )
+import dataclasses
+
 from photon_tpu.data.index_map import DefaultIndexMap
-from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.data import CSRMatrix, GameData, pad_game_data
 from photon_tpu.util import faults
 
 
@@ -274,10 +276,20 @@ class CachedDataReader:
         shard_configs: Mapping,
         id_tags: Sequence[str] = (),
         chunk_rows: int = 8192,
+        pad_final: bool = False,
     ) -> Iterator[GameData]:
         """Fixed-row chunks (last one smaller), the ``iter_chunks``
         contract of ``AvroDataReader`` — same chunk shapes for the same
-        ``chunk_rows`` regardless of how the SOURCE was partitioned."""
+        ``chunk_rows`` regardless of how the SOURCE was partitioned.
+
+        ``pad_final=True`` pads a short final chunk up to ``chunk_rows``
+        with zero-weight masked rows (``pad_game_data``: empty feature
+        rows, ``PAD_ENTITY_KEY`` id tags) so EVERY yielded chunk has the
+        same row count — the AOT-fixed-shape contract streaming fits
+        need. Padded chunks carry ``provenance["valid_rows"]`` (real row
+        count) and ``provenance["chunk_rows"]`` so consumers can mask or
+        un-pad without re-deriving the geometry.
+        """
         if chunk_rows < 1:
             raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
         n = self.num_samples
@@ -288,4 +300,17 @@ class CachedDataReader:
             # entry (the front door resumes the avro path chunk-aligned)
             faults.fault_point("cache.read")
             with obs.span("cache.read", cat="io", rows=hi - lo):
-                yield self._chunk(lo, hi, shard_configs, id_tags)
+                chunk = self._chunk(lo, hi, shard_configs, id_tags)
+            if pad_final and hi - lo < chunk_rows:
+                # pad_game_data rebuilds the GameData without provenance;
+                # re-attach it with the padding geometry recorded
+                prov = chunk.provenance or {}
+                chunk = dataclasses.replace(
+                    pad_game_data(chunk, chunk_rows),
+                    provenance={
+                        **prov,
+                        "valid_rows": hi - lo,
+                        "chunk_rows": chunk_rows,
+                    },
+                )
+            yield chunk

@@ -172,6 +172,35 @@ def build_parser() -> argparse.ArgumentParser:
         "overrides)",
     )
     p.add_argument(
+        "--stream-chunk-rows",
+        type=int,
+        default=None,
+        metavar="ROWS",
+        help="train OUT-OF-CORE: keep datasets host-resident and stream "
+        "fixed-shape chunks of ~ROWS sample rows through the "
+        "double-buffered sweep pipeline (game/streaming.py) — bounded "
+        "device residency, bit-identical coefficients, zero steady-state "
+        "compiles. Fixed-effect coordinates must be locked "
+        "(--partial-retrain-locked-coordinates) or absent. env "
+        "PHOTON_STREAM_CHUNK_ROWS overrides the value",
+    )
+    p.add_argument(
+        "--warm-start-input-directory",
+        default=None,
+        help="model checkpoint directory (sequence-numbered snapshots, "
+        "game/checkpoint.ModelCheckpointStore): warm-start the fit from "
+        "the newest valid snapshot — the daily-retrain entry point. An "
+        "empty or missing directory cold-starts with a warning (day "
+        "zero). Mutually exclusive with --model-input-directory",
+    )
+    p.add_argument(
+        "--model-checkpoint-directory",
+        default=None,
+        help="save the final trained model as the next sequence-numbered "
+        "snapshot here after the fit completes (often the same directory "
+        "as --warm-start-input-directory, closing the retrain loop)",
+    )
+    p.add_argument(
         "--checkpoint-sweeps",
         action="store_true",
         help="flush coordinate-descent state to <output>/checkpoints after "
@@ -363,6 +392,11 @@ def run(argv=None) -> dict:
         raise ValueError(
             "--ignore-threshold-for-new-models requires --model-input-directory"
         )
+    if args.warm_start_input_directory and args.model_input_directory:
+        raise ValueError(
+            "--warm-start-input-directory and --model-input-directory are "
+            "mutually exclusive (both supply the initial model)"
+        )
     from photon_tpu.evaluation.multi import GroupedEvaluatorSpec
     from photon_tpu.game.config import required_id_tags
 
@@ -539,6 +573,9 @@ def run(argv=None) -> dict:
                 initial_model=initial_model,
                 grid_callback=grid_callback,
                 checkpoint_dir=ckpt_dir,
+                stream=args.stream_chunk_rows,
+                warm_start=args.warm_start_input_directory,
+                model_checkpoint_dir=args.model_checkpoint_directory,
             )
         # None placeholders appear on a cross-process resume AND after an
         # in-process supervised restart that re-entered the grid loop

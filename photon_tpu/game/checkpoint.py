@@ -486,3 +486,298 @@ class DescentCheckpointer:
             + "; ".join(f.reason for f in failures)
             + ")",
         )
+
+
+# ---------------------------------------------------------------------------
+# Model-level checkpoints (the daily warm-start retrain contract)
+# ---------------------------------------------------------------------------
+
+MODEL_MANIFEST = "model-checkpoint.json"
+_MODEL_MANIFEST_RE = re.compile(r"model-manifest-(\d{8})\.json$")
+_MODEL_NPZ_RE = re.compile(r"model-(\d{8})\.npz$")
+
+
+class ModelCheckpointStore:
+    """Sequence-numbered MODEL snapshots for the daily retrain loop —
+    the warm-start side of the checkpoint story, distinct from the
+    mid-descent state checkpoints above:
+
+    * a DescentCheckpoint is layout-bound (its arrays are the live
+      optimizer states, resumable only under the exact same build
+      fingerprint) and exists so a KILLED fit can continue;
+    * a model snapshot is layout-INDEPENDENT (exported GameModel
+      coefficients keyed by entity, not by bucket slot), and exists so
+      TOMORROW's fit — over different data, different bucket shapes,
+      possibly a different chunk size — can warm-start from it via
+      ``GameEstimator.fit(warm_start=<dir>)``.
+
+    The sequence-number contract: every ``save`` writes
+    ``model-<seq>.npz`` + ``model-manifest-<seq>.json`` with a
+    monotonically increasing seq (continuing across process restarts),
+    ``load_latest`` returns the newest snapshot that passes its sha256,
+    falling back past torn heads exactly like the descent checkpointer,
+    and retention keeps the last ``checkpoint_keep()`` snapshots. A
+    warm-started fit therefore always resumes from "yesterday" =
+    highest valid seq, and a crashed save can never shadow it.
+
+    Fixed-effect and random-effect models round-trip exactly (f32/f64
+    bytes preserved); matrix-factorization coordinates are not
+    supported (no streaming MF either — one loud error, not a silent
+    drop).
+    """
+
+    def __init__(self, directory: str, keep: int | None = None):
+        self.directory = directory
+        self.keep = checkpoint_keep(keep)
+        os.makedirs(directory, exist_ok=True)
+        seqs = self._existing_seqs()
+        self._next_seq = (seqs[-1] + 1) if seqs else 0
+
+    # -- paths ---------------------------------------------------------
+
+    def _npz_path(self, seq: int) -> str:
+        return os.path.join(self.directory, f"model-{seq:08d}.npz")
+
+    def _manifest_path(self, seq: int) -> str:
+        return os.path.join(self.directory, f"model-manifest-{seq:08d}.json")
+
+    def _existing_seqs(self) -> list[int]:
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        return sorted(
+            int(m.group(1))
+            for m in (_MODEL_MANIFEST_RE.match(n) for n in names)
+            if m
+        )
+
+    # -- saving --------------------------------------------------------
+
+    def save(self, model) -> int:
+        """Write one snapshot; returns its sequence number."""
+        from photon_tpu.game.model import (
+            FixedEffectModel,
+            GameModel,
+            RandomEffectModel,
+        )
+
+        assert isinstance(model, GameModel)
+        arrays: dict[str, np.ndarray] = {}
+        coords: dict[str, dict] = {}
+        for cid, cm in model.coordinates.items():
+            if isinstance(cm, FixedEffectModel):
+                arrays[f"{cid}/means"] = np.asarray(cm.model.coefficients.means)
+                has_var = cm.model.coefficients.variances is not None
+                if has_var:
+                    arrays[f"{cid}/variances"] = np.asarray(
+                        cm.model.coefficients.variances
+                    )
+                coords[cid] = {
+                    "kind": "fixed",
+                    "feature_shard": cm.feature_shard,
+                    "task": cm.model.task.name,
+                    "has_variances": has_var,
+                }
+            elif isinstance(cm, RandomEffectModel):
+                arrays[f"{cid}/vocab"] = np.asarray(cm.vocab, dtype=np.str_)
+                if cm.projection_matrix is not None:
+                    arrays[f"{cid}/projection"] = np.asarray(
+                        cm.projection_matrix
+                    )
+                bucket_meta = []
+                for j, b in enumerate(cm.buckets):
+                    arrays[f"{cid}/b{j}/entity_ids"] = np.asarray(b.entity_ids)
+                    arrays[f"{cid}/b{j}/col_index"] = np.asarray(b.col_index)
+                    arrays[f"{cid}/b{j}/coefficients"] = np.asarray(
+                        b.coefficients
+                    )
+                    if b.variances is not None:
+                        arrays[f"{cid}/b{j}/variances"] = np.asarray(
+                            b.variances
+                        )
+                    bucket_meta.append(
+                        {"has_variances": b.variances is not None}
+                    )
+                coords[cid] = {
+                    "kind": "random",
+                    "random_effect_type": cm.random_effect_type,
+                    "feature_shard": cm.feature_shard,
+                    "task": cm.task.name,
+                    "num_features": int(cm.num_features),
+                    "has_projection": cm.projection_matrix is not None,
+                    "buckets": bucket_meta,
+                }
+            else:
+                raise ValueError(
+                    f"coordinate {cid!r}: {type(cm).__name__} snapshots are "
+                    "not supported by the model checkpoint store (FE and RE "
+                    "only)"
+                )
+        seq = self._next_seq
+        checksum = _atomic_write_npz(self._npz_path(seq), arrays)
+        manifest = {
+            "seq": seq,
+            "task": model.task.name,
+            "coordinates": coords,
+            "checksums": {"model": checksum},
+        }
+        payload = json.dumps(manifest)
+        self._write_text_atomic(self._manifest_path(seq), payload)
+        self._write_text_atomic(
+            os.path.join(self.directory, MODEL_MANIFEST), payload
+        )
+        self._next_seq = seq + 1
+        self._prune(seq)
+        obs.counter("checkpoint.model_saves")
+        return seq
+
+    def _write_text_atomic(self, path: str, text: str) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def _prune(self, newest_seq: int) -> None:
+        cutoff = newest_seq - self.keep + 1
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for name in names:
+            m = _MODEL_MANIFEST_RE.match(name) or _MODEL_NPZ_RE.match(name)
+            if m and int(m.group(1)) < cutoff:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    # -- loading -------------------------------------------------------
+
+    def _load_snapshot(self, manifest: dict):
+        from photon_tpu.game.model import (
+            BucketCoefficients,
+            FixedEffectModel,
+            GameModel,
+            RandomEffectModel,
+        )
+        from photon_tpu.models.coefficients import Coefficients
+        from photon_tpu.models.glm import model_for_task
+        from photon_tpu.types import TaskType
+
+        seq = int(manifest["seq"])
+        path = self._npz_path(seq)
+        checksum = (manifest.get("checksums") or {}).get("model")
+        if not os.path.exists(path):
+            raise CheckpointCorruptError(path, "file missing")
+        if checksum is not None:
+            actual = _sha256_file(path)
+            if actual != checksum:
+                raise CheckpointCorruptError(
+                    path,
+                    f"sha256 mismatch (manifest {checksum[:12]}…, "
+                    f"file {actual[:12]}…)",
+                )
+        coordinates = {}
+        try:
+            with np.load(path) as npz:
+                for cid, meta in manifest["coordinates"].items():
+                    if meta["kind"] == "fixed":
+                        variances = (
+                            jnp.asarray(npz[f"{cid}/variances"])
+                            if meta.get("has_variances")
+                            else None
+                        )
+                        glm = model_for_task(
+                            TaskType[meta["task"]],
+                            Coefficients(
+                                means=jnp.asarray(npz[f"{cid}/means"]),
+                                variances=variances,
+                            ),
+                        )
+                        coordinates[cid] = FixedEffectModel(
+                            model=glm, feature_shard=meta["feature_shard"]
+                        )
+                    else:
+                        buckets = []
+                        for j, bm in enumerate(meta["buckets"]):
+                            buckets.append(
+                                BucketCoefficients(
+                                    entity_ids=npz[f"{cid}/b{j}/entity_ids"],
+                                    col_index=npz[f"{cid}/b{j}/col_index"],
+                                    coefficients=npz[
+                                        f"{cid}/b{j}/coefficients"
+                                    ],
+                                    variances=(
+                                        npz[f"{cid}/b{j}/variances"]
+                                        if bm.get("has_variances")
+                                        else None
+                                    ),
+                                )
+                            )
+                        coordinates[cid] = RandomEffectModel(
+                            random_effect_type=meta["random_effect_type"],
+                            feature_shard=meta["feature_shard"],
+                            task=TaskType[meta["task"]],
+                            vocab=npz[f"{cid}/vocab"],
+                            buckets=tuple(buckets),
+                            num_features=int(meta["num_features"]),
+                            projection_matrix=(
+                                npz[f"{cid}/projection"]
+                                if meta.get("has_projection")
+                                else None
+                            ),
+                        )
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:  # zipfile.BadZipFile, KeyError, OSError, ...
+            raise CheckpointCorruptError(
+                path, f"{type(e).__name__}: {e}"
+            ) from e
+        return GameModel(
+            coordinates=coordinates, task=TaskType[manifest["task"]]
+        )
+
+    def load_latest(self):
+        """(GameModel, seq) from the newest valid snapshot; ``None`` when
+        the directory holds no model snapshot; raises
+        :class:`CheckpointCorruptError` when snapshots exist but none
+        validates (same never-silently-start-fresh rule as the descent
+        loader)."""
+        seqs = self._existing_seqs()
+        if not seqs:
+            return None
+        failures: list[CheckpointCorruptError] = []
+        for seq in reversed(seqs):
+            try:
+                with open(self._manifest_path(seq)) as f:
+                    manifest = json.load(f)
+                model = self._load_snapshot(manifest)
+            except (OSError, json.JSONDecodeError) as e:
+                failures.append(
+                    CheckpointCorruptError(
+                        self._manifest_path(seq), f"{type(e).__name__}: {e}"
+                    )
+                )
+                obs.counter("recovery.checkpoint_fallback")
+                continue
+            except CheckpointCorruptError as e:
+                failures.append(e)
+                logger.warning(
+                    "model snapshot %d invalid, falling back: %s", seq, e
+                )
+                obs.counter("recovery.checkpoint_fallback")
+                continue
+            return model, seq
+        raise CheckpointCorruptError(
+            failures[0].path,
+            f"no valid model snapshot in {self.directory} "
+            f"({len(failures)} tried: "
+            + "; ".join(f.reason for f in failures)
+            + ")",
+        )

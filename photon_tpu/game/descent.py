@@ -231,7 +231,15 @@ def _copy_device_leaves(tree):
     storage — and on the relay a per-leaf eager copy would pay the ~72 ms
     dispatch floor per state leaf (~20 at the config-5 shape), so the
     whole tree copies in a single dispatch, counted like every other
-    sweep-path launch."""
+    sweep-path launch. Streaming coordinates keep their states as HOST
+    numpy (game/streaming.py) — those trees copy on host; routing them
+    through the jit copy would be an implicit round-trip the sanitizer
+    flags."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if leaves and not any(isinstance(l, jax.Array) for l in leaves):
+        import numpy as np
+
+        return jax.tree_util.tree_map(np.array, tree)
     dispatch_count.record(1)
     return _copy_tree_jit(tree)
 

@@ -196,6 +196,16 @@ class GameEstimator:
     #: blocks, the FE batch), and a long-lived estimator must not hold
     #: the prior fit's footprint through its next phase.
     keep_coordinates: bool = False
+    #: out-of-core streaming training (game/streaming.py): a
+    #: StreamConfig, an int chunk size, or True (env/default chunk
+    #: size). When set, datasets stay HOST-resident and every sweep
+    #: streams fixed-shape chunks through a two-deep host→device double
+    #: buffer — peak device residency bounded at 2 chunks + tables
+    #: (ledger-verified when ``assert_residency``), coefficients
+    #: BIT-IDENTICAL to the materialized path. Requires mesh=None in
+    #: process (multi-PROCESS ``ingest_shard`` slices compose), locked
+    #: fixed effects, no device validation scorer, no MF coordinates.
+    stream: object | None = None
 
     def __post_init__(self):
         #: per-fit telemetry deltas (wall, dispatches, compiles) for the
@@ -281,17 +291,78 @@ class GameEstimator:
         logger.info("RE shape pool: %s", pool.stats())
         return pool
 
+    def _validate_streaming(self, stream_cfg, validation_data):
+        """Everything streaming mode refuses, rejected at fit entry with
+        the actionable message — never discovered mid-sweep."""
+        from photon_tpu.game.streaming import StreamingModeError
+
+        if self.mesh is not None:
+            raise StreamingModeError(
+                "streaming fits are per-process (mesh=None): an in-process "
+                "device mesh keeps the materialized path; multi-PROCESS "
+                "scale-out streams disjoint ingest_shard slices instead"
+            )
+        if validation_data is not None and self.validation_evaluator is not None:
+            raise StreamingModeError(
+                "streaming fits do not support the device validation "
+                "scorer (it materializes the validation set on device); "
+                "evaluate the returned model host-side instead"
+            )
+        for cid, cfg in self.coordinate_configs.items():
+            if isinstance(cfg, MatrixFactorizationCoordinateConfig):
+                raise StreamingModeError(
+                    f"coordinate {cid!r}: matrix-factorization coordinates "
+                    "are not streamable (factor-table training gathers "
+                    "arbitrary rows per chunk)"
+                )
+            if (
+                isinstance(cfg, FixedEffectCoordinateConfig)
+                and cid not in self.locked_coordinates
+            ):
+                raise StreamingModeError(
+                    f"coordinate {cid!r}: streaming fits require "
+                    "fixed-effect coordinates to be LOCKED (the global "
+                    "L-BFGS cannot train bit-exactly from chunks); train "
+                    "it materialized first, then stream with it locked — "
+                    "the daily-retrain shape"
+                )
+            if cfg.optimization.variance_computation.value != "NONE":
+                raise StreamingModeError(
+                    f"coordinate {cid!r}: streaming fits do not compute "
+                    "coefficient variances; set variance_computation=NONE"
+                )
+
     def _build_coordinates(
-        self, data: GameData, initial_model=None, shape_pool=None
+        self, data: GameData, initial_model=None, shape_pool=None,
+        stream_cfg=None,
     ):
         coords = {}
         re_datasets = {}
         norm = self.normalization_contexts or {}
+        stream_telemetry = None
+        if stream_cfg is not None:
+            from photon_tpu.game.streaming import StreamTelemetry
+
+            stream_telemetry = StreamTelemetry()
         if shape_pool is None:
             with obs.span("fit.shape_profile"):
                 shape_pool = self._build_shape_pool(data, initial_model)
         for cid, cfg in self.coordinate_configs.items():
             if isinstance(cfg, FixedEffectCoordinateConfig):
+                if stream_cfg is not None:
+                    from photon_tpu.game.streaming import (
+                        StreamingFixedEffectCoordinate,
+                    )
+
+                    coords[cid] = StreamingFixedEffectCoordinate.build_streaming(
+                        data,
+                        cfg,
+                        norm.get(cfg.feature_shard, NormalizationContext()),
+                        self.dtype,
+                        stream=stream_cfg,
+                        telemetry=stream_telemetry,
+                    )
+                    continue
                 coords[cid] = FixedEffectCoordinate.build(
                     data,
                     cfg,
@@ -317,9 +388,21 @@ class GameEstimator:
                     shape_pool=shape_pool,
                 )
                 re_datasets[cid] = ds
-                coords[cid] = RandomEffectCoordinate.build(
-                    data, ds, cfg, self.dtype, mesh=self.mesh
-                )
+                if stream_cfg is not None:
+                    from photon_tpu.game.streaming import (
+                        StreamingRandomEffectCoordinate,
+                    )
+
+                    coords[cid] = (
+                        StreamingRandomEffectCoordinate.build_streaming(
+                            ds, cfg, self.dtype, stream=stream_cfg,
+                            telemetry=stream_telemetry,
+                        )
+                    )
+                else:
+                    coords[cid] = RandomEffectCoordinate.build(
+                        data, ds, cfg, self.dtype, mesh=self.mesh
+                    )
                 waste = ds.padding_waste()
                 logger.info(
                     "coordinate %s: %d entities in %d buckets "
@@ -357,6 +440,9 @@ class GameEstimator:
         checkpoint_every: int = 1,
         shape_pool=None,
         mesh=None,
+        stream=None,
+        warm_start: str | None = None,
+        model_checkpoint_dir: str | None = None,
     ) -> list[GameTrainingResult]:
         """Train one GameModel per λ-grid point, warm-starting across the
         grid (reference fit :304-390 + train :746).
@@ -403,6 +489,27 @@ class GameEstimator:
         program audit. Checkpoints fingerprint the mesh TOPOLOGY (axis
         names + shape), and a resume re-places loaded states onto each
         coordinate's declared sharding.
+
+        ``stream`` (per-fit override of the constructor field — a
+        StreamConfig, an int chunk size, or True) trains OUT-OF-CORE:
+        datasets stay host-resident and every sweep streams fixed-shape
+        chunks through the double-buffered pipeline (game/streaming.py)
+        with ledger-verified bounded residency — bit-identical
+        coefficients, zero steady-state compiles, one (host no-op)
+        barrier per sweep. ``self.last_fit_stats["stream"]`` then
+        carries the chunk/stage-wall/H2D-overlap/residency report.
+
+        ``warm_start`` names a model checkpoint DIRECTORY
+        (:class:`photon_tpu.game.checkpoint.ModelCheckpointStore`): the
+        newest valid sequence-numbered snapshot loads as the
+        ``initial_model`` — the daily-retrain entry point, where
+        today's fit updates only entities present in today's data and
+        every other entity's model carries over bit-identically. An
+        EMPTY directory cold-starts with a warning (day zero);
+        combining ``warm_start`` with an explicit ``initial_model`` is
+        an error. ``model_checkpoint_dir`` (often the same directory)
+        saves the final grid point's model as the next snapshot after
+        the fit completes, so tomorrow's run finds it.
         """
         from photon_tpu.util import compile_watch, dispatch_count
 
@@ -411,6 +518,36 @@ class GameEstimator:
             # every placement the build performs, so it must be settled
             # before the data/coordinate build below
             self.mesh = mesh
+        if stream is None:
+            stream = self.stream
+        stream_cfg = None
+        if stream is not None and stream is not False:
+            from photon_tpu.game.streaming import StreamConfig
+
+            stream_cfg = StreamConfig.resolve(stream)
+            self._validate_streaming(stream_cfg, validation_data)
+        if warm_start is not None:
+            if initial_model is not None:
+                raise ValueError(
+                    "pass either warm_start (a model checkpoint directory) "
+                    "or initial_model, not both"
+                )
+            from photon_tpu.game.checkpoint import ModelCheckpointStore
+
+            loaded = ModelCheckpointStore(warm_start).load_latest()
+            if loaded is None:
+                logger.warning(
+                    "warm_start directory %s holds no model snapshot; "
+                    "cold-starting (day zero of the retrain loop)",
+                    warm_start,
+                )
+            else:
+                initial_model, warm_seq = loaded
+                logger.info(
+                    "warm-starting from model snapshot seq %d in %s",
+                    warm_seq, warm_start,
+                )
+                obs.counter("fit.warm_starts")
 
         emitter = self.events
         t_fit = time.perf_counter()
@@ -451,6 +588,7 @@ class GameEstimator:
                     checkpoint_dir=checkpoint_dir,
                     checkpoint_every=checkpoint_every,
                     shape_pool=shape_pool,
+                    stream_cfg=stream_cfg,
                 )
 
             try:
@@ -497,7 +635,30 @@ class GameEstimator:
                 "ingest": prov.get("source", "host"),
                 **cw,
             }
-            fit_span.set(**self.last_fit_stats)
+            if getattr(self, "_stream_telemetry", None) is not None:
+                # chunk pipeline report: stage waterfall, H2D overlap
+                # split, residency-guard peak — the bench gates read it
+                self.last_fit_stats["stream"] = self._stream_telemetry.report()
+                self._stream_telemetry = None
+            fit_span.set(
+                **{
+                    k: v
+                    for k, v in self.last_fit_stats.items()
+                    if not isinstance(v, dict)
+                }
+            )
+            if model_checkpoint_dir is not None:
+                from photon_tpu.game.checkpoint import ModelCheckpointStore
+
+                final = [r for r in results if r is not None]
+                if final:
+                    seq = ModelCheckpointStore(model_checkpoint_dir).save(
+                        final[-1].model
+                    )
+                    logger.info(
+                        "saved model snapshot seq %d to %s",
+                        seq, model_checkpoint_dir,
+                    )
             if emitter is not None:
                 evals = [
                     r.evaluation
@@ -527,6 +688,7 @@ class GameEstimator:
         checkpoint_dir,
         checkpoint_every,
         shape_pool,
+        stream_cfg=None,
     ) -> list[GameTrainingResult]:
         if self.ignore_threshold_for_new_models and initial_model is None:
             raise ValueError(
@@ -539,7 +701,13 @@ class GameEstimator:
 
                 data = pad_game_data(data, int(self.mesh.devices.size))
             coordinates, re_datasets = self._build_coordinates(
-                data, initial_model, shape_pool=shape_pool
+                data, initial_model, shape_pool=shape_pool,
+                stream_cfg=stream_cfg,
+            )
+        self._stream_telemetry = None
+        if stream_cfg is not None:
+            self._stream_telemetry = self._arm_stream_guard(
+                coordinates, stream_cfg
             )
         if self.mesh is not None:
             # shard-uniformity contract (the PR 3 shape budget on a
@@ -664,16 +832,17 @@ class GameEstimator:
                     ckpt.grid_index,
                     ckpt.iteration,
                 )
-                if self.mesh is not None:
-                    # the snapshot's leaves load as host arrays; the
-                    # first meshed dispatch must see the DECLARED
-                    # shardings, not pay an implicit reshard (which the
-                    # sanitizer flags and the AOT executables reject)
-                    ckpt.states = self._place_states(ckpt.states, coordinates)
-                    if ckpt.best_states is not None:
-                        ckpt.best_states = self._place_states(
-                            ckpt.best_states, coordinates
-                        )
+                # the snapshot's leaves load as host arrays; the first
+                # dispatch must see each coordinate's DECLARED placement
+                # — a mesh sharding, or HOST numpy for streaming
+                # coordinates — not pay an implicit reshard (which the
+                # sanitizer flags and the AOT executables reject).
+                # No-op for plain single-device coordinates.
+                ckpt.states = self._place_states(ckpt.states, coordinates)
+                if ckpt.best_states is not None:
+                    ckpt.best_states = self._place_states(
+                        ckpt.best_states, coordinates
+                    )
 
         results = []
         states = init_states
@@ -794,6 +963,59 @@ class GameEstimator:
 
     # ------------------------------------------------------------------
 
+    def _arm_stream_guard(self, coordinates, stream_cfg):
+        """Arm the bounded-residency assertion for a streaming fit: the
+        shared StreamTelemetry gets a ResidencyGuard whose limit is the
+        ISSUE's structural bound — ``2 × chunk_bytes + tables`` (tables
+        = the FE coefficient/normalization vectors that legitimately
+        stay device-resident across a score stream; RE tables are
+        host-resident in streaming so they contribute ZERO device
+        bytes) plus allocator slack. Every chunk placement samples live
+        device bytes against it and raises ResidencyError on breach."""
+        from photon_tpu.game.streaming import (
+            StreamingFixedEffectCoordinate,
+            StreamingRandomEffectCoordinate,
+        )
+        from photon_tpu.obs import memory as obs_memory
+
+        telemetry = None
+        chunk_bytes = 0
+        table_bytes = 0
+        for coord in coordinates.values():
+            if isinstance(
+                coord,
+                (
+                    StreamingFixedEffectCoordinate,
+                    StreamingRandomEffectCoordinate,
+                ),
+            ):
+                telemetry = coord.telemetry
+                chunk_bytes = max(chunk_bytes, coord.max_chunk_device_bytes())
+            if isinstance(coord, StreamingFixedEffectCoordinate):
+                # state + factors + shifts ride on device for the whole
+                # score stream — the "tables" term of the bound
+                itemsize = int(jnp.dtype(coord.dtype).itemsize)
+                table_bytes += 3 * coord.num_features * itemsize
+        if telemetry is None:
+            return None
+        if stream_cfg.assert_residency:
+            limit = (
+                2 * chunk_bytes + table_bytes
+                + stream_cfg.residency_slack_bytes
+            )
+            telemetry.guard = obs_memory.ResidencyGuard(
+                limit, label="train.stream"
+            )
+            logger.info(
+                "streaming residency guard armed: limit %d B "
+                "(2 x %d chunk + %d tables + %d slack) over a %d B "
+                "baseline",
+                limit, chunk_bytes, table_bytes,
+                stream_cfg.residency_slack_bytes,
+                telemetry.guard.baseline_bytes,
+            )
+        return telemetry
+
     def _to_model(self, coordinates, states) -> GameModel:
         # Include every coordinate with a state — locked coordinates outside
         # the update sequence still contribute scores during descent and
@@ -840,10 +1062,23 @@ class GameEstimator:
                 lookup = prior.dense_coefficient_lookup()
                 prior_idx = {k: i for i, k in enumerate(prior.vocab)}
                 bucket_states = []
-                for db, host_bucket in zip(
-                    coord.device_buckets, coord.dataset.buckets
+                # device_buckets carry the authoritative (possibly mesh-
+                # padded) shapes; streaming coordinates hold NO device
+                # buckets, so their shapes come from the host dataset
+                shapes = (
+                    [
+                        (db.features.shape[0], db.features.shape[2])
+                        for db in coord.device_buckets
+                    ]
+                    if coord.device_buckets
+                    else [
+                        (b.num_entities, b.projected_dim)
+                        for b in coord.dataset.buckets
+                    ]
+                )
+                for (e, d), host_bucket in zip(
+                    shapes, coord.dataset.buckets
                 ):
-                    e, d = db.features.shape[0], db.features.shape[2]
                     w0 = np.zeros((e, d), dtype=np.float32)
                     for i, ent in enumerate(host_bucket.entity_ids):
                         pi = prior_idx.get(coord.dataset.vocab[ent])

@@ -50,11 +50,14 @@ import threading
 
 __all__ = [
     "MemoryLedger",
+    "ResidencyError",
+    "ResidencyGuard",
     "census",
     "count_d2h",
     "count_h2d",
     "enabled",
     "get_ledger",
+    "live_device_bytes",
     "record_executable",
 ]
 
@@ -278,6 +281,89 @@ def count_h2d(nbytes: int) -> None:
 def count_d2h(nbytes: int) -> None:
     if enabled() and nbytes:
         _ledger.count_d2h(nbytes)
+
+
+def live_device_bytes() -> int:
+    """Σ ``nbytes`` over every live ``jax.Array`` handle — the same
+    enumeration a census groups, reduced to one number. Host metadata
+    only (no dispatch, no read-back); half-deleted handles are skipped
+    like the census skips them."""
+    import jax
+
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            total += int(arr.nbytes)
+        except Exception:
+            continue
+    return total
+
+
+class ResidencyError(RuntimeError):
+    """A streaming fit's live device residency exceeded its declared
+    bound — the loud failure the bounded-residency contract demands
+    instead of silently ballooning toward the materialized footprint."""
+
+
+class ResidencyGuard:
+    """Assertion mode over the ledger's live-bytes view: a streaming fit
+    arms one guard with its declared residency budget (``2 ×
+    chunk_bytes + tables`` over the baseline that was live before the
+    stream started) and the chunk pipeline samples it at every
+    host→device placement — the point where residency peaks. A sample
+    over budget raises :class:`ResidencyError` with the full accounting;
+    the running peak feeds the stream report and the ``mem.peak_bytes``
+    watermark either way.
+
+    Sampling cost is one ``jax.live_arrays()`` enumeration per chunk
+    (host metadata only). The guard is built per fit, never shared.
+    """
+
+    def __init__(
+        self,
+        limit_bytes: int,
+        *,
+        baseline_bytes: int | None = None,
+        label: str = "train.stream",
+    ):
+        self.limit_bytes = int(limit_bytes)
+        self.baseline_bytes = (
+            live_device_bytes() if baseline_bytes is None
+            else int(baseline_bytes)
+        )
+        self.label = label
+        self.peak_bytes = self.baseline_bytes
+        self.samples = 0
+
+    def sample(self) -> int:
+        """Measure live device bytes, update the peak, and raise
+        :class:`ResidencyError` when residency over the baseline exceeds
+        the armed limit. Returns the measured live bytes."""
+        live = live_device_bytes()
+        self.samples += 1
+        if live > self.peak_bytes:
+            self.peak_bytes = live
+            with _ledger._lock:
+                _ledger._peak_bytes = max(_ledger._peak_bytes, live)
+        over_baseline = live - self.baseline_bytes
+        if over_baseline > self.limit_bytes:
+            raise ResidencyError(
+                f"{self.label}: live device residency "
+                f"{live} B ({over_baseline} B over the {self.baseline_bytes} B "
+                f"baseline) exceeds the declared streaming budget of "
+                f"{self.limit_bytes} B (2 x chunk bytes + tables) — the "
+                "chunk pipeline is retaining more than its double buffer"
+            )
+        return live
+
+    def report(self) -> dict:
+        return {
+            "baseline_bytes": self.baseline_bytes,
+            "limit_bytes": self.limit_bytes,
+            "peak_bytes": self.peak_bytes,
+            "peak_over_baseline_bytes": self.peak_bytes - self.baseline_bytes,
+            "samples": self.samples,
+        }
 
 
 def tree_device_bytes(tree) -> int:

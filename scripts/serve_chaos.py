@@ -25,6 +25,15 @@ recovery contracts on the LIVE ``/slo`` burn plane:
          registry manifest and serves the backlog late (burn excursion,
          then recovery); at-least-once across the crash: every seq gets
          an answer, all scores bit-match the cold scorer.
+  leg 4  TRAINING-side producer kill (ISSUE 17): the streaming fit's
+         host→device producer thread dies mid-sweep
+         (``train.stream.producer@1=error``) — the training driver must
+         fail LOUDLY (ProducerDiedError, nonzero rc, no torn model
+         snapshot in the retrain checkpoint directory), a mid-chunk
+         ``train.stream.chunk`` I/O fault must likewise surface the
+         original error, and the daily-retrain relaunch (warm-start
+         against the same, still-empty checkpoint directory) must
+         complete bit-exact against the uninterrupted streaming run.
 
 Every leg also enforces the zero-traffic-time-compile gate from the
 server's own summary (``backend_compiles == swap_build_compiles``) and
@@ -59,7 +68,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
-from chaos_drive import SHARD_ARG, make_records, run_cli, training_args, write_data  # noqa: E402
+from chaos_drive import SHARD_ARG, make_records, model_hash, run_cli, training_args, write_data  # noqa: E402
 from live_probe import free_port, get  # noqa: E402
 
 #: one fixed serving batch shape — requests pack 4-to-a-batch at most
@@ -752,6 +761,122 @@ def leg_server_kill(fx: dict, work: str) -> None:
           "answered across the SIGKILL, bit parity on all")
 
 
+def leg_stream_producer_kill(work: str, n: int) -> None:
+    """The training-side chaos leg: kill the streaming fit's producer
+    thread mid-sweep through the ``train.stream.*`` fault registry
+    (photon_tpu/game/streaming.py) and prove the daily-retrain loop
+    recovers bit-exact. No serving fixtures needed — this leg drives
+    ``photon_tpu.cli.game_training`` with ``--stream-chunk-rows``."""
+    label = "leg4 stream-producer-kill"
+    leg = os.path.join(work, "leg4")
+    data_root = os.path.join(leg, "data")
+    os.makedirs(leg, exist_ok=True)
+    write_data(data_root, n)
+    train_mod = "photon_tpu.cli.game_training"
+    # pin the chunk size against ambient PHOTON_STREAM_CHUNK_ROWS (the
+    # CI streaming job exports one): baseline, faulted, and recovery
+    # runs must share one chunk geometry or bit parity is meaningless
+    chunk_env = {"PHOTON_STREAM_CHUNK_ROWS": "96"}
+
+    def stream_args(out_root: str, ckpt_dir: str, *, warm: bool = False):
+        # RE-only coordinate: streaming trains random effects; a
+        # trainable fixed effect would be rejected (StreamingModeError)
+        args = [
+            "--input-data-directories", os.path.join(data_root, "train"),
+            "--root-output-directory", out_root,
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--feature-shard-configurations", SHARD_ARG,
+            "--coordinate-configurations",
+            "name=per-user,random.effect.type=userId,feature.shard=global,"
+            "max.iter=10,regularization=L2,reg.weights=1",
+            "--coordinate-update-sequence", "per-user",
+            "--coordinate-descent-iterations", "3",
+            "--stream-chunk-rows", "96",
+            "--model-checkpoint-directory", ckpt_dir,
+        ]
+        if warm:
+            args += ["--warm-start-input-directory", ckpt_dir]
+        return args
+
+    def has_snapshot(ckpt_dir: str) -> bool:
+        if not os.path.isdir(ckpt_dir):
+            return False
+        return any(
+            name.startswith("model-manifest-") and name.endswith(".json")
+            for name in os.listdir(ckpt_dir)
+        )
+
+    # baseline: the uninterrupted streaming run the recovery is compared
+    # against; its checkpoint directory must hold snapshot seq 0
+    base_out = os.path.join(leg, "baseline")
+    base_ckpt = os.path.join(leg, "baseline-ckpt")
+    run_cli(
+        train_mod, stream_args(base_out, base_ckpt),
+        env=chunk_env, label=f"{label} baseline",
+    )
+    if not has_snapshot(base_ckpt):
+        die(f"{label}: baseline saved no model snapshot in {base_ckpt}")
+    base_hash = model_hash(os.path.join(base_out, "best"))
+    print(f"[serve-chaos] {label}: baseline model hash {base_hash[:16]}…")
+
+    # producer kill: the host→device feed thread dies on its first
+    # start — the fit must fail loudly (watchdog converts the dead
+    # producer to ProducerDiedError) and save NO model snapshot
+    chaos_out = os.path.join(leg, "chaos")
+    chaos_ckpt = os.path.join(leg, "chaos-ckpt")
+    proc = run_cli(
+        train_mod, stream_args(chaos_out, chaos_ckpt),
+        env={**chunk_env, "PHOTON_FAULTS": "train.stream.producer@1=error"},
+        expect_rc=None, label=f"{label} producer-kill",
+    )
+    if proc.returncode == 0:
+        die(f"{label}: fit succeeded under a dead producer")
+    if "ProducerDiedError" not in (proc.stdout + proc.stderr):
+        print(proc.stdout[-3000:])
+        print(proc.stderr[-3000:])
+        die(f"{label}: failure was not classified as ProducerDiedError")
+    if has_snapshot(chaos_ckpt):
+        die(f"{label}: the FAILED fit left a model snapshot behind")
+    print(f"[serve-chaos] {label}: producer death surfaced as "
+          f"ProducerDiedError (rc={proc.returncode}), no torn snapshot")
+
+    # mid-chunk I/O fault: the other train.stream.* registry point —
+    # the ORIGINAL error class must propagate, not a generic wrapper
+    io_out = os.path.join(leg, "chaos-io")
+    proc = run_cli(
+        train_mod, stream_args(io_out, chaos_ckpt),
+        env={**chunk_env, "PHOTON_FAULTS": "train.stream.chunk@2=io_error"},
+        expect_rc=None, label=f"{label} chunk-io-fault",
+    )
+    if proc.returncode == 0:
+        die(f"{label}: fit succeeded under a mid-chunk I/O fault")
+    if "InjectedIOError" not in (proc.stdout + proc.stderr):
+        print(proc.stdout[-3000:])
+        print(proc.stderr[-3000:])
+        die(f"{label}: chunk fault did not propagate the original error")
+    if has_snapshot(chaos_ckpt):
+        die(f"{label}: the I/O-faulted fit left a model snapshot behind")
+    print(f"[serve-chaos] {label}: mid-chunk I/O fault propagated "
+          f"InjectedIOError (rc={proc.returncode})")
+
+    # recovery: the daily-retrain relaunch warm-starts against the SAME
+    # (still empty) checkpoint directory — day zero semantics: cold
+    # start with a warning, finish, save seq 0, bit-exact vs baseline
+    rec_out = os.path.join(leg, "recovery")
+    run_cli(
+        train_mod, stream_args(rec_out, chaos_ckpt, warm=True),
+        env=chunk_env, label=f"{label} recovery",
+    )
+    if not has_snapshot(chaos_ckpt):
+        die(f"{label}: recovery saved no model snapshot in {chaos_ckpt}")
+    rec_hash = model_hash(os.path.join(rec_out, "best"))
+    if rec_hash != base_hash:
+        die(f"{label} PARITY FAIL: recovery {rec_hash[:16]}… != "
+            f"baseline {base_hash[:16]}…")
+    print(f"[serve-chaos] {label}: GREEN — recovery relaunch bit-matches "
+          "the uninterrupted streaming run")
+
+
 # -- entry ------------------------------------------------------------------
 
 
@@ -760,8 +885,9 @@ def main() -> int:
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--n", type=int, default=400)
     ap.add_argument(
-        "--leg", choices=["1", "2", "3", "all"], default="all",
-        help="run one leg (fixtures always build)",
+        "--leg", choices=["1", "2", "3", "4", "all"], default="all",
+        help="run one leg (serving fixtures build for legs 1-3; leg 4 "
+        "is the training-side streaming leg and builds its own data)",
     )
     # the producer subcommand (internal; spawned by the legs)
     ap.add_argument("--producer", action="store_true", help=argparse.SUPPRESS)
@@ -783,13 +909,16 @@ def main() -> int:
     os.makedirs(work, exist_ok=True)
     print(f"[serve-chaos] workdir: {work}")
 
-    fx = build_fixtures(work, args.n)
-    if args.leg in ("1", "all"):
-        leg_producer_kill(fx, work)
-    if args.leg in ("2", "all"):
-        leg_swap_stall(fx, work)
-    if args.leg in ("3", "all"):
-        leg_server_kill(fx, work)
+    if args.leg in ("1", "2", "3", "all"):
+        fx = build_fixtures(work, args.n)
+        if args.leg in ("1", "all"):
+            leg_producer_kill(fx, work)
+        if args.leg in ("2", "all"):
+            leg_swap_stall(fx, work)
+        if args.leg in ("3", "all"):
+            leg_server_kill(fx, work)
+    if args.leg in ("4", "all"):
+        leg_stream_producer_kill(work, args.n)
     print("[serve-chaos] ALL LEGS GREEN")
     return 0
 

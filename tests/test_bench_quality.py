@@ -217,3 +217,40 @@ def test_serving_swap_band_semantics():
         detail = dict(healthy, **poison)
         violations = bench.check_quality_bands("game_serving_swap", detail)
         assert any(needle in v for v in violations), (poison, violations)
+
+
+def test_daily_retrain_band_semantics():
+    """The daily warm-start retrain bands (ISSUE 17): the warm delta day
+    >= 3x faster than the cold streaming fit (steady sweep walls), the
+    double buffer actually overlapping H2D with compute, zero compiles
+    leaking into the chunk loop, and bit-exact carryover for untouched
+    entities. A row that retrained nothing measured nothing."""
+    healthy = {
+        "stream": {"h2d_overlap_fraction": 0.87, "chunks": 53},
+        "stream_steady_compiles": 0,
+        "retrain": {
+            "warm_speedup": 7.4,
+            "touched_entities": 10,
+            "carryover_bit_exact": True,
+        },
+    }
+    assert bench.check_quality_bands("glmix_daily_retrain", healthy) == []
+    for poison, needle in (
+        ({"retrain": {"warm_speedup": 1.2, "touched_entities": 10,
+                      "carryover_bit_exact": True}}, "speedup"),
+        ({"retrain": {"warm_speedup": None, "touched_entities": 10,
+                      "carryover_bit_exact": True}}, "speedup"),
+        ({"retrain": {"warm_speedup": float("nan"), "touched_entities": 10,
+                      "carryover_bit_exact": True}}, "speedup"),
+        ({"stream": {"h2d_overlap_fraction": 0.1}}, "overlap"),
+        ({"stream": {}}, "overlap"),
+        ({"stream_steady_compiles": 2}, "retrace"),
+        ({"stream_steady_compiles": None}, "retrace"),
+        ({"retrain": {"warm_speedup": 7.4, "touched_entities": 10,
+                      "carryover_bit_exact": False}}, "carryover"),
+        ({"retrain": {"warm_speedup": 7.4, "touched_entities": 0,
+                      "carryover_bit_exact": True}}, "measured nothing"),
+    ):
+        detail = dict(healthy, **poison)
+        violations = bench.check_quality_bands("glmix_daily_retrain", detail)
+        assert any(needle in v for v in violations), (poison, violations)

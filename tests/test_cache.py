@@ -147,6 +147,54 @@ def test_iter_chunks_parity_across_uneven_part_files(dataset, chunk_rows):
         assert w.provenance and w.provenance["source"] == "cache"
 
 
+def test_iter_chunks_pad_final_fixed_shape_partial_tail(dataset):
+    """n % chunk_rows != 0: pad_final must yield the tail at exactly
+    chunk_rows rows — zero-weight masked pad rows, PAD_ENTITY_KEY tags,
+    empty feature rows — with the padding geometry in provenance (the
+    AOT-fixed-shape contract streaming fits consume)."""
+    from photon_tpu.game.data import PAD_ENTITY_KEY
+
+    d, _, maps = dataset  # n = 41
+    resolve_reader(d, SHARDS, index_maps=maps, id_tags=TAGS, mode="use").read()
+    reader = CachedDataReader(default_cache_dir([d], SHARDS, TAGS))
+    plain = list(reader.iter_chunks(SHARDS, id_tags=TAGS, chunk_rows=16))
+    padded = list(
+        reader.iter_chunks(SHARDS, id_tags=TAGS, chunk_rows=16, pad_final=True)
+    )
+    assert [c.num_samples for c in plain] == [16, 16, 9]
+    assert [c.num_samples for c in padded] == [16, 16, 16]
+    # full chunks are untouched (identical data, cache provenance)
+    for a, b in zip(plain[:-1], padded[:-1]):
+        _assert_game_data_equal(a, b)
+        assert b.provenance["source"] == "cache"
+        assert "valid_rows" not in b.provenance
+    tail = padded[-1]
+    assert tail.provenance["source"] == "cache"
+    assert tail.provenance["valid_rows"] == 9
+    assert tail.provenance["chunk_rows"] == 16
+    # the real rows survive bit-identically
+    real = plain[-1]
+    assert np.array_equal(tail.labels[:9], real.labels)
+    assert np.array_equal(tail.offsets[:9], real.offsets)
+    assert np.array_equal(tail.weights[:9], real.weights)
+    m_t, m_r = tail.feature_shards["g"], real.feature_shards["g"]
+    assert np.array_equal(m_t.indptr[:10], m_r.indptr)
+    assert np.array_equal(m_t.indices, m_r.indices)
+    assert np.array_equal(m_t.values, m_r.values)
+    assert list(tail.id_tags["userId"][:9]) == list(real.id_tags["userId"])
+    # the pad rows are masked out of every weighted reduction + grouping
+    assert np.all(tail.weights[9:] == 0)
+    assert np.all(tail.labels[9:] == 0)
+    assert np.all(m_t.indptr[9:] == m_t.indptr[9])  # empty feature rows
+    assert all(k == PAD_ENTITY_KEY for k in tail.id_tags["userId"][9:])
+    # evenly divisible: pad_final is a no-op (41 rows / chunk_rows=41)
+    whole = list(
+        reader.iter_chunks(SHARDS, id_tags=TAGS, chunk_rows=41, pad_final=True)
+    )
+    assert [c.num_samples for c in whole] == [41]
+    assert "valid_rows" not in whole[0].provenance
+
+
 def test_unseen_entity_keys_round_trip(tmp_path):
     """Entity ids no model vocabulary contains are just strings to the
     cache — codes+vocab must reproduce them exactly."""

@@ -1,0 +1,385 @@
+"""Out-of-core streaming training (photon_tpu/game/streaming.py + the
+estimator's stream/warm_start plumbing): streaming-vs-materialized
+BIT-parity, ledger-verified bounded residency, zero steady-state
+compiles, pipeline fault conversion (train.stream.* chaos points), the
+daily warm-start delta-day contract, and the sequence-numbered model
+checkpoint store.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_tpu.game.checkpoint import ModelCheckpointStore
+from photon_tpu.game.config import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import CSRMatrix, GameData
+from photon_tpu.game.estimator import GameEstimator
+from photon_tpu.game.scoring import ProducerDiedError
+from photon_tpu.game.streaming import (
+    StreamConfig,
+    StreamingModeError,
+    stream_chunk_rows,
+)
+from photon_tpu.obs import memory as obs_memory
+from photon_tpu.optimize.common import OptimizerConfig
+from photon_tpu.optimize.problem import (
+    GLMProblemConfig,
+    RegularizationContext,
+    RegularizationType,
+    VarianceComputationType,
+)
+from photon_tpu.types import TaskType
+from photon_tpu.util import faults
+
+
+def _opt(max_iterations=4, **kw):
+    return GLMProblemConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        optimizer_config=OptimizerConfig(max_iterations=max_iterations),
+        **kw,
+    )
+
+
+def _data(seed=0, n=600, d_fe=6, d_re=4, users=40, user_pool=None):
+    """GameData with a global shard and a per-user shard; ``user_pool``
+    restricts which user ids appear (the delta-day construction)."""
+    rng = np.random.default_rng(seed)
+    ids = rng.zipf(1.4, size=n) % users
+    if user_pool is not None:
+        ids = np.asarray(user_pool)[ids % len(user_pool)]
+    x = rng.normal(size=(n, d_fe))
+    y = x @ rng.normal(size=d_fe) * 0.3 + rng.normal(size=n) * 0.1
+    return GameData.build(
+        labels=y,
+        feature_shards={
+            "g": CSRMatrix.from_dense(x),
+            "s_userId": CSRMatrix.from_dense(rng.normal(size=(n, d_re))),
+        },
+        id_tags={"userId": [f"u{int(i)}" for i in ids]},
+    )
+
+
+def _re_est(descent_iterations=3, **kw):
+    return GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="s_userId",
+                optimization=_opt(),
+                regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["user"],
+        descent_iterations=descent_iterations,
+        **kw,
+    )
+
+
+def _fe_re_est(locked=True, **kw):
+    return GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard="g",
+                optimization=_opt(),
+                regularization_weights=(1.0,),
+            ),
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="s_userId",
+                optimization=_opt(),
+                regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["fixed", "user"],
+        descent_iterations=2,
+        locked_coordinates=frozenset({"fixed"}) if locked else frozenset(),
+        **kw,
+    )
+
+
+def _assert_re_models_bit_equal(a, b):
+    assert list(a.vocab) == list(b.vocab)
+    assert len(a.buckets) == len(b.buckets)
+    for ba, bb in zip(a.buckets, b.buckets):
+        assert list(ba.entity_ids) == list(bb.entity_ids)
+        assert np.array_equal(
+            np.asarray(ba.coefficients), np.asarray(bb.coefficients)
+        )
+
+
+# ---------------------------------------------------------------------------
+# bit parity + bounded residency + compile-free steady state
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_fit_bit_parity_bounded_residency_zero_steady_compiles():
+    """THE acceptance bundle on a small GLMix config: same seeds →
+    bit-identical coefficients, ≥4 chunks per sweep through the double
+    buffer, peak residency within the armed 2-chunks+tables bound, and
+    zero steady-state compiles in the sweep tracker."""
+    data = _data()
+    est_m = _re_est()
+    est_s = _re_est()
+    res_m = est_m.fit(data)
+    res_s = est_s.fit(data, stream=128)
+
+    _assert_re_models_bit_equal(
+        res_m[0].model.coordinates["user"], res_s[0].model.coordinates["user"]
+    )
+
+    st = est_s.last_fit_stats["stream"]
+    # chunked for real: well over 4 chunks per sweep at chunk_rows=128
+    assert st["chunks"] >= 4 * 3
+    assert st["streams"] > 0
+    assert st["h2d_bytes"] > 0
+    # the double buffer genuinely overlapped H2D with in-flight compute
+    assert st["overlapped_h2d_bytes"] > 0
+    assert set(st["stage_seconds"]) >= {
+        "queue", "h2d", "dispatch", "readback", "pipeline",
+    }
+    # ledger-verified bounded residency: sampled at every placement peak
+    res = st["residency"]
+    assert res["samples"] == st["chunks"]
+    assert res["peak_over_baseline_bytes"] <= res["limit_bytes"]
+    # materialized fits carry no stream report
+    assert "stream" not in est_m.last_fit_stats
+
+    # zero steady-state compiles: every sweep row past the first shows 0
+    sweep_rows = [r for r in res_s[0].tracker if "sweep_seconds" in r]
+    assert len(sweep_rows) == 3
+    assert all(r["compiles"] == 0 for r in sweep_rows if r["iteration"] >= 1)
+
+
+def test_streaming_fit_with_locked_fixed_effect_bit_parity():
+    """The daily-retrain shape: a locked FE coordinate streams its score
+    while the RE coordinate trains — bit-identical against the same
+    locked-FE fit on the materialized path."""
+    data = _data(seed=3)
+    # day-zero materialized fit trains the FE model everyone locks
+    base = _fe_re_est(locked=False).fit(data)[0].model
+
+    est_m = _fe_re_est()
+    est_s = _fe_re_est()
+    res_m = est_m.fit(data, initial_model=base)
+    res_s = est_s.fit(data, stream=96, initial_model=base)
+
+    mm, ms = res_m[0].model, res_s[0].model
+    # locked FE ships unchanged through both paths
+    fe_m = np.asarray(mm.coordinates["fixed"].model.coefficients.means)
+    fe_s = np.asarray(ms.coordinates["fixed"].model.coefficients.means)
+    assert np.array_equal(fe_m, fe_s)
+    assert np.array_equal(
+        fe_m, np.asarray(base.coordinates["fixed"].model.coefficients.means)
+    )
+    _assert_re_models_bit_equal(
+        mm.coordinates["user"], ms.coordinates["user"]
+    )
+    # the FE score stream contributed chunks too
+    assert est_s.last_fit_stats["stream"]["chunks"] > 0
+
+
+def test_streaming_residency_breach_fails_loudly(monkeypatch):
+    """The assertion mode has teeth: with the guard's limit forced to
+    zero the first chunk placement must raise ResidencyError."""
+    real_guard = obs_memory.ResidencyGuard
+
+    class _ZeroLimit(real_guard):
+        def __init__(self, limit_bytes, **kw):
+            super().__init__(0, **kw)
+
+    monkeypatch.setattr(obs_memory, "ResidencyGuard", _ZeroLimit)
+    with pytest.raises(obs_memory.ResidencyError):
+        _re_est().fit(_data(), stream=128)
+
+
+def test_streaming_residency_assertion_opt_out():
+    est = _re_est(descent_iterations=1)
+    est.fit(_data(), stream=StreamConfig(chunk_rows=128, assert_residency=False))
+    assert "residency" not in est.last_fit_stats["stream"]
+
+
+# ---------------------------------------------------------------------------
+# mode validation: unsupported scope fails at fit entry
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_rejects_trainable_fixed_effect():
+    with pytest.raises(StreamingModeError, match="LOCKED"):
+        _fe_re_est(locked=False).fit(_data(), stream=128)
+
+
+def test_streaming_rejects_coefficient_variances():
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinate_configs={
+            "user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard="s_userId",
+                optimization=_opt(
+                    variance_computation=VarianceComputationType.SIMPLE
+                ),
+                regularization_weights=(1.0,),
+            ),
+        },
+        update_sequence=["user"],
+    )
+    with pytest.raises(StreamingModeError, match="variance"):
+        est.fit(_data(), stream=128)
+
+
+def test_stream_config_resolution(monkeypatch):
+    # the CI streaming leg exports PHOTON_STREAM_CHUNK_ROWS (env wins
+    # over every explicit value); these equalities test the no-env path
+    monkeypatch.delenv("PHOTON_STREAM_CHUNK_ROWS", raising=False)
+    assert StreamConfig.resolve(256).chunk_rows == 256
+    assert StreamConfig.resolve(True).chunk_rows == stream_chunk_rows()
+    cfg = StreamConfig(chunk_rows=64, queue_depth=3)
+    assert StreamConfig.resolve(cfg).queue_depth == 3
+    with pytest.raises(TypeError):
+        StreamConfig.resolve("8192")
+
+
+# ---------------------------------------------------------------------------
+# chaos: the train.stream.* fault points
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_producer_death_converts_to_producer_died_error():
+    """train.stream.producer sits OUTSIDE the producer's try: an
+    injected error kills the thread abruptly (no _Failure hand-off) and
+    the consumer watchdog must convert the silence into
+    ProducerDiedError."""
+    with faults.injected("train.stream.producer@1=error"):
+        with pytest.raises(ProducerDiedError):
+            _re_est(descent_iterations=1).fit(_data(), stream=128)
+
+
+def test_chunk_fault_propagates_original_error():
+    """train.stream.chunk reports through the normal _Failure hand-off:
+    the consumer re-raises the ORIGINAL exception, not a wrapper."""
+    with faults.injected("train.stream.chunk@2=io_error"):
+        with pytest.raises(faults.InjectedIOError):
+            _re_est(descent_iterations=1).fit(_data(), stream=128)
+
+
+# ---------------------------------------------------------------------------
+# warm start: the delta-day contract
+# ---------------------------------------------------------------------------
+
+
+def _entity_coef_map(re_model):
+    out = {}
+    for b in re_model.buckets:
+        for i, e in enumerate(b.entity_ids):
+            out[re_model.vocab[e]] = np.asarray(b.coefficients[i])
+    return out
+
+
+def test_warm_start_updates_only_delta_day_entities(tmp_path):
+    """fit(warm_start=dir) resumes from yesterday's snapshot and
+    retrains ONLY entities present in the delta day; every other
+    entity's model carries over bit-identically."""
+    ckpt = str(tmp_path / "daily")
+    day0 = _data(seed=0, n=600, users=40)
+    est0 = _re_est()
+    est0.fit(day0, stream=128, model_checkpoint_dir=ckpt)
+    store = ModelCheckpointStore(ckpt)
+    model0, seq0 = store.load_latest()
+    assert seq0 == 0
+    coef0 = _entity_coef_map(model0.coordinates["user"])
+
+    # the delta day touches a small user subset only
+    delta_users = [1, 2, 5]
+    day1 = _data(seed=9, n=96, users=40, user_pool=delta_users)
+    touched = set(day1.id_tags["userId"])
+    assert touched < set(coef0)  # strictly a subset of modeled entities
+
+    est1 = _re_est()
+    res1 = est1.fit(
+        day1, stream=64, warm_start=ckpt, model_checkpoint_dir=ckpt
+    )
+    model1 = res1[0].model.coordinates["user"]
+    coef1 = _entity_coef_map(model1)
+
+    # nothing lost: day-0 entities all survive the merge
+    assert set(coef0) <= set(coef1)
+    untouched = set(coef0) - touched
+    assert untouched  # the construction guarantees a carryover set
+    for k in untouched:
+        assert np.array_equal(coef0[k], coef1[k]), k
+    # the delta-day entities actually retrained on the new data
+    assert any(
+        not np.array_equal(coef0[k], coef1[k]) for k in touched
+    )
+    # the snapshot sequence advanced for tomorrow's run
+    _, seq1 = store.load_latest()
+    assert seq1 == 1
+
+
+def test_warm_start_empty_directory_cold_starts(tmp_path):
+    d = str(tmp_path / "empty")
+    os.makedirs(d)
+    est = _re_est(descent_iterations=1)
+    res = est.fit(_data(), stream=128, warm_start=d)
+    assert res[0].model is not None
+    assert ModelCheckpointStore(d).load_latest() is None  # nothing saved
+
+
+def test_warm_start_conflicts_with_initial_model(tmp_path):
+    est = _re_est(descent_iterations=1)
+    day0 = _re_est(descent_iterations=1).fit(_data())[0].model
+    with pytest.raises(ValueError, match="not both"):
+        est.fit(
+            _data(), warm_start=str(tmp_path), initial_model=day0
+        )
+
+
+# ---------------------------------------------------------------------------
+# the sequence-numbered model checkpoint store
+# ---------------------------------------------------------------------------
+
+
+def test_model_checkpoint_store_roundtrip_and_prune(tmp_path):
+    d = str(tmp_path / "store")
+    model = _re_est(descent_iterations=1).fit(_data())[0].model
+    store = ModelCheckpointStore(d, keep=2)
+    assert store.load_latest() is None
+    assert store.save(model) == 0
+    assert store.save(model) == 1
+    assert store.save(model) == 2  # prunes seq 0
+    names = sorted(os.listdir(d))
+    assert "model-00000000.npz" not in names
+    assert "model-00000002.npz" in names
+    loaded, seq = store.load_latest()
+    assert seq == 2
+    _assert_re_models_bit_equal(
+        model.coordinates["user"], loaded.coordinates["user"]
+    )
+
+
+def test_model_checkpoint_store_falls_back_past_corruption(tmp_path):
+    d = str(tmp_path / "store")
+    model = _re_est(descent_iterations=1).fit(_data())[0].model
+    store = ModelCheckpointStore(d)
+    store.save(model)
+    store.save(model)
+    # tear the newest snapshot's payload: load must fall back to seq 0
+    with open(os.path.join(d, "model-00000001.npz"), "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00" * 16)
+    loaded, seq = store.load_latest()
+    assert seq == 0
+    _assert_re_models_bit_equal(
+        model.coordinates["user"], loaded.coordinates["user"]
+    )
