@@ -243,6 +243,14 @@ QUALITY_BANDS = {
     "game_scoring_tail": {
         "tail_p99_s_max": 5.0,
         "tail_slo_ok": True,
+        # arming the causal trace plane at sample_n=1 (every request
+        # recorded — worst-case record volume) may not move the paced
+        # leg's p99 by more than 100% of the disarmed p99. Deliberately
+        # loose: p99 on a loaded 2-core builder is noisy and the gate is
+        # "recording is cheap relative to the leg", not a microbenchmark
+        # hero number — scripts/measure_obs_overhead.py is where tight
+        # overhead experiments run
+        "trace_overhead_p99_frac_max": 1.0,
     },
     # the hot-swap config's whole claim is "zero downtime": a swap that
     # failed or dropped even one request, or whose post-flip answers
@@ -401,6 +409,20 @@ def check_quality_bands(name: str, detail: dict) -> list[str]:
             out.append(
                 "sustained leg breached its armed SLO: "
                 f"{'; '.join(tail.get('slo_violations') or ['no gate data'])}"
+            )
+    trace_frac_max = band.get("trace_overhead_p99_frac_max")
+    # presence-gated: rows from before the trace-overhead A/B existed
+    # (metric_version history, legacy fixtures) carry no "trace_overhead"
+    # section and must keep passing; any row that RAN the A/B — including
+    # one whose armed leg detonated — is fully gated
+    if trace_frac_max is not None and "trace_overhead" in detail:
+        to = detail.get("trace_overhead") or {}
+        frac = to.get("p99_delta_frac")
+        if frac is None or not math.isfinite(frac) or frac > trace_frac_max:
+            out.append(
+                f"arming the causal trace plane moved the paced leg's p99 "
+                f"by {frac} of the disarmed p99 (> {trace_frac_max}; "
+                "recording is not cheap relative to the leg)"
             )
     swap_failed_max = band.get("serve_swap_failed_requests_max")
     if swap_failed_max is not None:
@@ -2607,6 +2629,50 @@ def config_scoring_tail(peak_flops, scale):
     paths = doc["artifacts"]
     sustained = doc["legs"][0]
     top = doc["legs"][-1]
+
+    # trace-overhead A/B (ISSUE 19): the identical paced leg twice over a
+    # fresh workload — causal trace plane disarmed, then armed at
+    # sample_n=1 so EVERY request records its full event chain (worst-case
+    # record volume, no sampling relief). Banded as a fraction of the
+    # disarmed p99 (trace_overhead_p99_frac_max) — the claim under gate is
+    # "arming tracing does not detonate the tail", measured on the same
+    # Poisson schedule both sides.
+    from photon_tpu.obs import causal as obs_causal
+
+    ab_requests = min(num_requests, 24)
+    ab_qps = 0.5 * doc["capacity_qps"]
+    scorer_ab, chunks_ab = load_harness.build_workload(
+        num_requests=ab_requests,
+        batch_rows=batch_rows,
+        d=d,
+        nnz=nnz,
+        users=users,
+        seed=16,
+    )
+    # pin the env so PHOTON_TRACE=1 in the caller's shell cannot re-arm
+    # the "off" leg through the scorer's ensure_from_env() hook
+    saved_trace_env = os.environ.pop("PHOTON_TRACE", None)
+    try:
+        obs_causal.clear()
+        leg_off = load_harness.run_leg(
+            scorer_ab, chunks_ab, qps=ab_qps, seed=16
+        )
+        obs_causal.install(sample_n=1)
+        leg_on = load_harness.run_leg(
+            scorer_ab, chunks_ab, qps=ab_qps, seed=16
+        )
+    finally:
+        obs_causal.clear()
+        obs.reset()
+        if saved_trace_env is not None:
+            os.environ["PHOTON_TRACE"] = saved_trace_env
+    p99_off = leg_off["latency_s"].get("p99")
+    p99_on = leg_on["latency_s"].get("p99")
+    trace_delta_frac = (
+        round((p99_on - p99_off) / p99_off, 4)
+        if p99_on is not None and p99_off
+        else None
+    )
     return {
         "n": num_requests * batch_rows,
         "batch_rows": batch_rows,
@@ -2626,6 +2692,14 @@ def config_scoring_tail(peak_flops, scale):
             "slo_violations": sustained["slo_violations"],
         },
         "examples_per_sec": top["samples_per_sec"],
+        "trace_overhead": {
+            "requests": ab_requests,
+            "offered_qps": round(ab_qps, 3),
+            "sample_n": 1,
+            "p99_off_s": p99_off,
+            "p99_on_s": p99_on,
+            "p99_delta_frac": trace_delta_frac,
+        },
         "obs": {
             "slo_report_path": paths.get("slo"),
             "metrics_path": paths.get("metrics"),

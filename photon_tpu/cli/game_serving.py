@@ -262,9 +262,10 @@ def run(argv=None) -> dict:
     with game_base.run_profile(out_root), PhotonLogger(
         os.path.join(out_root, "driver.log"), level=args.log_level
     ) as log:
-        from photon_tpu.obs import slo
+        from photon_tpu.obs import causal, slo
 
         slo.ensure_from_env()
+        causal.ensure_from_env()
         registry = ModelRegistry(
             mem_budget_bytes=args.mem_budget_bytes,
             manifest_path=manifest_path,

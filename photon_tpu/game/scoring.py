@@ -71,7 +71,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu import obs
-from photon_tpu.obs import slo
+from photon_tpu.obs import causal, slo
 from photon_tpu.game.data import (
     GameData,
     _ceil_pow2,
@@ -350,6 +350,9 @@ class _ChunkItem:
     birth_t: float
     decode_s: float
     decoded_t: float
+    #: the chunk's causal trace (obs/causal.py TraceCtx; the shared null
+    #: context when tracing is disarmed, None for hand-built items)
+    trace: object = None
 
 
 class _StageCounter:
@@ -731,10 +734,14 @@ class GameScorer:
                     continue
             return False
 
+        ctx = causal.null()
         try:
             while not stop.is_set():
                 t_pull = time.perf_counter()
-                with obs.span("score.decode"):
+                # one causal trace per chunk, minted before decode so an
+                # injected decode fault lands inside this chunk's chain
+                ctx = causal.mint("score.chunk", kind="score")
+                with ctx.active(), obs.span("score.decode"):
                     # chaos hook inside the try: a decode fault reports
                     # through the normal _Failure hand-off (the source's
                     # own per-file retries have already been spent by
@@ -760,7 +767,15 @@ class GameScorer:
                     birth_t=birth,
                     decode_s=max(0.0, t_decoded - max(t_pull, birth)),
                     decoded_t=t_decoded,
+                    trace=ctx,
                 )
+                # decode slice + the flow START the consumer's assemble
+                # arrow binds to (flow ts inside the slice)
+                ctx.event(
+                    "score.decode", t_decoded - item.decode_s,
+                    item.decode_s, cat="score", rows=chunk.num_samples,
+                )
+                ctx.flow("s", t_decoded - item.decode_s)
                 with staged.lock:
                     staged.value += 1
                     stats.max_staged_chunks = max(
@@ -769,6 +784,7 @@ class GameScorer:
                 if not put(item):
                     return
         except BaseException as e:  # propagate into the consumer loop
+            ctx.finish("error")
             put(_Failure(e))
 
     def _next_item(self, q: queue.Queue, producer: threading.Thread):
@@ -825,8 +841,10 @@ class GameScorer:
         stats = StreamStats()
         # arm the latency SLO from PHOTON_SLO_SPEC (no-op when unset or
         # when a tracker was installed programmatically) — driver runs
-        # get deadline tracking with no code change
+        # get deadline tracking with no code change; same deal for the
+        # causal trace plane via PHOTON_TRACE
         slo.ensure_from_env()
+        causal.ensure_from_env()
         collected: list[np.ndarray] = [] if collect_scores else None
         q: queue.Queue = queue.Queue(maxsize=MAX_STAGED_CHUNKS - 1)
         stop = threading.Event()
@@ -843,6 +861,7 @@ class GameScorer:
         def finish(pending) -> None:
             dev_scores, item, t_dispatch, stages, t_enqueued = pending
             chunk = item.chunk
+            tr = item.trace if item.trace is not None else causal.null()
             t_r0 = time.perf_counter()
             # the double-buffer hold: batch i's read-back is deferred
             # until batch i+1 enqueues — real latency from this batch's
@@ -859,6 +878,20 @@ class GameScorer:
                         : chunk.num_samples
                     ].astype(np.float64)
             stages["readback"] = time.perf_counter() - t_r0
+            # pipeline (the double-buffer hold) CONTAINS the next
+            # batch's assemble/h2d/dispatch slices on this track —
+            # Perfetto nests them, which IS the overlap, visible
+            tr.event(
+                "score.pipeline", t_enqueued, stages["pipeline"],
+                cat="score",
+            )
+            tr.event(
+                "score.readback", t_r0, stages["readback"],
+                cat="score", rows=chunk.num_samples,
+            )
+            # flow FINISH inside the read-back slice: the arrow closing
+            # this chunk's causal chain
+            tr.flow("f", t_r0)
             wall = time.perf_counter() - t_dispatch
             if not stats.batch_walls_s:
                 stats.compiles_first_batch = compile_watch.delta(cw_start)
@@ -875,6 +908,9 @@ class GameScorer:
                 with obs.span("score.write", rows=chunk.num_samples):
                     on_batch(chunk, scores)
                 stages["write"] = time.perf_counter() - t_w0
+                tr.event(
+                    "score.write", t_w0, stages["write"], cat="score"
+                )
             # the batch's latency lifecycle closes HERE: end-to-end wall
             # from birth (scheduled arrival / decode start) through the
             # sink write, per-stage walls into their histograms, and the
@@ -887,6 +923,9 @@ class GameScorer:
                 obs.histogram(f"score.stage_seconds.{stage}", sec)
             obs.histogram("score.e2e_seconds", e2e)
             dominant = slo.observe_batch(e2e, stages)
+            tr.finish(
+                "ok" if dominant is None else "deadline", e2e_s=e2e
+            )
             if dominant is not None:
                 stats.deadline_violations += 1
                 stats.violations_by_stage[dominant] = (
@@ -954,6 +993,22 @@ class GameScorer:
                             self.batch_rows - chunk.num_samples,
                         )
                     stages["assemble"] = time.perf_counter() - t_pickup
+                    tr = (
+                        item.trace
+                        if item.trace is not None
+                        else causal.null()
+                    )
+                    # assemble slice on the consumer track; the queue
+                    # wait rides as an arg (a queue slice would partially
+                    # overlap the previous batch's consumer slices) and
+                    # the flow arrow from the decode slice shows the
+                    # hand-off gap visually
+                    tr.event(
+                        "score.assemble", t_pickup, stages["assemble"],
+                        cat="score", rows=chunk.num_samples,
+                        queue_s=round(stages["queue"], 6),
+                    )
+                    tr.flow("t", t_pickup)
 
                     # per-batch retry-with-requeue: the decoded chunk is
                     # still on host, so a transient H2D/dispatch failure
@@ -987,12 +1042,15 @@ class GameScorer:
                         return self._dispatch(batch_dev, key)
 
                     t_dispatch = time.perf_counter()
-                    dev_scores = retry_call(
-                        run_batch,
-                        policy=BATCH_RETRY_POLICY,
-                        classify=is_transient,
-                        label="score_batch",
-                    )
+                    # trace active through the retry scope so injected
+                    # scoring.batch faults attach to THIS chunk's chain
+                    with tr.active():
+                        dev_scores = retry_call(
+                            run_batch,
+                            policy=BATCH_RETRY_POLICY,
+                            classify=is_transient,
+                            label="score_batch",
+                        )
                     # stage split: h2d = the placement walls (across
                     # retries); dispatch = everything else in the retry
                     # path — the async enqueue, injected pre-H2D faults,
@@ -1001,6 +1059,16 @@ class GameScorer:
                     stages["dispatch"] = (
                         time.perf_counter() - t_dispatch
                     ) - h2d_acc[0]
+                    # contiguous approximation of the measured walls:
+                    # H2D then dispatch, from the dispatch stamp
+                    tr.event(
+                        "score.h2d", t_dispatch, stages["h2d"],
+                        cat="score",
+                    )
+                    tr.event(
+                        "score.dispatch", t_dispatch + stages["h2d"],
+                        stages["dispatch"], cat="score", tries=tries,
+                    )
                     if tries > 1:
                         stats.batch_retries += tries - 1
                         obs.counter("score.batch_retries", tries - 1)

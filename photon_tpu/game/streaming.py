@@ -93,6 +93,7 @@ from photon_tpu.game.scoring import (
 )
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import model_for_task
+from photon_tpu.obs import causal
 from photon_tpu.obs import memory as obs_memory
 from photon_tpu.ops.normalization import NormalizationContext
 from photon_tpu.optimize.problem import GLMProblem
@@ -277,16 +278,28 @@ def _produce(
                 continue
         return False
 
+    ctx = causal.null()
     try:
         while not stop.is_set():
-            faults.fault_point("train.stream.chunk")
-            item = next(chunk_iter, _DONE)
+            t_pull = time.perf_counter()
+            # one causal trace per training chunk (obs/causal.py),
+            # minted before assembly so an injected chunk fault lands
+            # inside this chunk's chain; the consumer receives
+            # (trace, item) pairs — sentinels travel bare
+            ctx = causal.mint("train.chunk", kind="train")
+            with ctx.active():
+                faults.fault_point("train.stream.chunk")
+                item = next(chunk_iter, _DONE)
             if item is _DONE:
                 put(_DONE)
                 return
-            if not put(item):
+            t_done = time.perf_counter()
+            ctx.event("train.produce", t_pull, t_done - t_pull, cat="train")
+            ctx.flow("s", t_pull)
+            if not put((ctx, item)):
                 return
     except BaseException as e:  # propagate into the consumer loop
+        ctx.finish("error")
         put(_Failure(e))
 
 
@@ -354,6 +367,7 @@ def run_stream(
     dev_item) -> out`` dispatches without blocking; ``sink_fn(item,
     out)`` owns the sanctioned read-back. Returns the chunk count.
     """
+    causal.ensure_from_env()
     q: queue.Queue = queue.Queue(maxsize=max(1, stream.queue_depth))
     stop = threading.Event()
     watchdog = stream_watchdog_s(stream.watchdog_s)
@@ -368,39 +382,65 @@ def run_stream(
     n_chunks = 0
     pending = None  # (host_item, dev_out) awaiting read-back
     t_stream = time.perf_counter()
+    def retire(held) -> None:
+        """Read back + write back the held chunk and close its trace:
+        the flow FINISH lands inside the read-back slice, so chunk k's
+        closing arrow visibly crosses chunk k+1's H2D slice — the
+        two-deep overlap, auditable in Perfetto instead of asserted."""
+        ctx, item, out = held
+        t2 = time.perf_counter()
+        sink_fn(item, out)
+        rb_s = time.perf_counter() - t2
+        telemetry.record_stage("readback", rb_s)
+        ctx.event("train.readback", t2, rb_s, cat="train")
+        ctx.flow("f", t2)
+        ctx.finish("ok")
+
     try:
         while True:
             t0 = time.perf_counter()
             item = _next_item(q, producer, watchdog)
-            telemetry.record_stage("queue", time.perf_counter() - t0)
+            queue_s = time.perf_counter() - t0
+            telemetry.record_stage("queue", queue_s)
             if isinstance(item, _Failure):
                 raise item.exc
             if item is _DONE:
                 break
-            faults.fault_point("train.stream.h2d")
+            ctx, item = item
+            try:
+                with ctx.active():
+                    faults.fault_point("train.stream.h2d")
+            except BaseException:
+                ctx.finish("fault")
+                raise
             t1 = time.perf_counter()
             dev_item, nbytes = put_fn(item)
             h2d_s = time.perf_counter() - t1
             telemetry.record_stage("h2d", h2d_s)
             telemetry.record_chunk(nbytes, h2d_s, overlapped=pending is not None)
+            ctx.event(
+                "train.h2d", t1, h2d_s, cat="train",
+                nbytes=int(nbytes), queue_s=round(queue_s, 6),
+            )
+            ctx.flow("t", t1)
             if telemetry.guard is not None:
                 # sampled at the residency PEAK: the just-placed chunk
                 # plus the previous chunk still in flight
                 telemetry.guard.sample()
             if pending is not None:
-                t2 = time.perf_counter()
-                sink_fn(*pending)
-                telemetry.record_stage("readback", time.perf_counter() - t2)
+                retire(pending)
             t3 = time.perf_counter()
             dispatch_count.record(1)
-            out = run_fn(item, dev_item)
-            telemetry.record_stage("dispatch", time.perf_counter() - t3)
-            pending = (item, out)
+            with ctx.active():
+                out = run_fn(item, dev_item)
+            dispatch_s = time.perf_counter() - t3
+            telemetry.record_stage("dispatch", dispatch_s)
+            ctx.event("train.dispatch", t3, dispatch_s, cat="train")
+            ctx.flow("t", t3)
+            pending = (ctx, item, out)
             n_chunks += 1
         if pending is not None:
-            t2 = time.perf_counter()
-            sink_fn(*pending)
-            telemetry.record_stage("readback", time.perf_counter() - t2)
+            retire(pending)
             pending = None
     finally:
         stop.set()

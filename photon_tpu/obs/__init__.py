@@ -50,7 +50,16 @@ from __future__ import annotations
 import logging
 import os
 
-from photon_tpu.obs import fleet, flight, health, http, memory, series, slo
+from photon_tpu.obs import (
+    causal,
+    fleet,
+    flight,
+    health,
+    http,
+    memory,
+    series,
+    slo,
+)
 from photon_tpu.obs.export import (
     chrome_trace,
     export_artifacts,
@@ -71,6 +80,7 @@ __all__ = [
     "MetricsRegistry",
     "Span",
     "Tracer",
+    "causal",
     "chrome_trace",
     "counter",
     "disable",
@@ -143,6 +153,7 @@ def reset() -> None:
     fleet.clear_breakdown()
     fleet.clear_sweeps_cache()
     slo.reset_run_state()
+    causal.reset_run_state()
 
 
 def span(name: str, cat: str = "phase", **args) -> Span:

@@ -206,6 +206,17 @@ def export_artifacts(
             json.dump(_json_safe({**(meta or {}), "slo": slo_doc}), f,
                       indent=2, sort_keys=True)
         paths["slo"] = slo_path
+    # the causal-trace exemplars (photon_tpu/obs/causal.py): the same
+    # Perfetto-loadable document /trace serves — written only when the
+    # trace plane is armed, so untraced runs keep the historical layout
+    from photon_tpu.obs import causal as obs_causal
+
+    if obs_causal.active() is not None:
+        trace_path = _path("trace_exemplars.json")
+        with open(trace_path, "w") as f:
+            json.dump(_json_safe(obs_causal.chrome_trace(meta)), f,
+                      indent=2, sort_keys=True)
+        paths["trace_exemplars"] = trace_path
     summary_path = _path("summary.txt")
     with open(summary_path, "w") as f:
         f.write(summary_table(tracer) + "\n")

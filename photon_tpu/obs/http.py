@@ -511,6 +511,15 @@ class _Handler(BaseHTTPRequestHandler):
                     json.dumps(slo.report(), default=str) + "\n"
                 ).encode()
                 ctype = "application/json"
+            elif self.path.split("?")[0] == "/trace":
+                from photon_tpu.obs import causal
+
+                # Perfetto-loadable Chrome-trace JSON of the retained
+                # causal traces (sampled ring + worst-K tail exemplars)
+                body = (
+                    json.dumps(causal.chrome_trace(), default=str) + "\n"
+                ).encode()
+                ctype = "application/json"
             elif self.path.split("?")[0] == "/blackbox":
                 from photon_tpu.obs import flight
 
@@ -569,7 +578,7 @@ class TelemetryServer:
         self._thread.start()
         logger.info(
             "obs endpoints live at http://127.0.0.1:%d"
-            "{/metrics,/healthz,/slo,/blackbox}", self.port,
+            "{/metrics,/healthz,/slo,/trace,/blackbox}", self.port,
         )
         return self.port
 

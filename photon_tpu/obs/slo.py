@@ -209,6 +209,20 @@ class SloTracker:
         self.violations = 0
         self.by_stage: dict[str, int] = {}
         self._events: deque = deque(maxlen=self.MAX_EVENTS)
+        # the fastest burn window, tracked incrementally so the causal
+        # trace plane can ask "is the burn window hot RIGHT NOW" per
+        # finished trace without rescanning the event deque
+        self._fast_window_s = min(spec.burn_windows_s())
+        self._fast: deque = deque()
+        self._fast_violations = 0
+
+    def _prune_fast_locked(self, now: float) -> None:
+        cutoff = now - self._fast_window_s
+        fast = self._fast
+        while fast and fast[0][0] < cutoff:
+            _, violated = fast.popleft()
+            if violated:
+                self._fast_violations -= 1
 
     def observe(self, e2e_s: float, stages: dict | None) -> str | None:
         """Record one finished batch; returns the dominant stage name
@@ -219,13 +233,31 @@ class SloTracker:
         dominant = None
         if violated:
             dominant = dominant_stage(stages) or "unattributed"
+        now = time.perf_counter()
         with self._lock:
             self.batches += 1
-            self._events.append((time.perf_counter(), violated))
+            self._events.append((now, violated))
+            self._fast.append((now, violated))
             if violated:
                 self.violations += 1
+                self._fast_violations += 1
                 self.by_stage[dominant] = self.by_stage.get(dominant, 0) + 1
+            self._prune_fast_locked(now)
         return dominant
+
+    def fast_burning(self, now: float | None = None) -> bool:
+        """True when the FASTEST burn window is consuming error budget
+        faster than the spec tolerates (rate > 1) — the exemplar
+        nomination signal: traces finishing while this is hot are tail
+        context worth retaining even when individually within budget."""
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            self._prune_fast_locked(now)
+            n = len(self._fast)
+            if not n:
+                return False
+            rate = (self._fast_violations / n) / self.spec.error_budget
+        return rate > 1.0
 
     def burn_rates(self, now: float | None = None) -> dict:
         """Per-window burn rates: ``violating fraction / error budget``
@@ -263,6 +295,8 @@ class SloTracker:
             self.violations = 0
             self.by_stage.clear()
             self._events.clear()
+            self._fast.clear()
+            self._fast_violations = 0
 
 
 def dominant_stage(stages: dict | None) -> str | None:

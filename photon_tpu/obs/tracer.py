@@ -27,6 +27,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+from photon_tpu.obs import causal
+
 
 @dataclass
 class SpanRecord:
@@ -43,14 +45,21 @@ class SpanRecord:
     instant: bool = False
 
 
-def _trace_annotation(name: str):
-    """A jax.profiler.TraceAnnotation for ``name``, or None when the
-    profiler is unavailable (host spans then simply don't show up in
-    device traces — everything else keeps working)."""
+def _trace_annotation(name: str, **meta):
+    """A jax.profiler.TraceAnnotation for ``name`` carrying ``meta``
+    (span/trace IDs, so device-profiler slices join back to host spans
+    and causal traces), or None when the profiler is unavailable (host
+    spans then simply don't show up in device traces — everything else
+    keeps working)."""
     try:
         import jax.profiler
 
-        return jax.profiler.TraceAnnotation(name)
+        try:
+            return jax.profiler.TraceAnnotation(name, **meta)
+        except TypeError:
+            # older jax: TraceAnnotation takes no metadata kwargs —
+            # fall back to the bare named annotation
+            return jax.profiler.TraceAnnotation(name)
     except Exception:  # pragma: no cover - profiler unavailable
         return None
 
@@ -109,7 +118,11 @@ class Span:
             self._parent_id = stack[-1] if stack else None
             stack.append(self.span_id)
             if tracer.annotate_device:
-                self._ann = _trace_annotation(self.name)
+                meta = {"span_id": self.span_id}
+                trace_id = causal.current_trace_id()
+                if trace_id is not None:
+                    meta["trace_id"] = trace_id
+                self._ann = _trace_annotation(self.name, **meta)
                 if self._ann is not None:
                     self._ann.__enter__()
         self._t0_ns = time.perf_counter_ns()

@@ -37,6 +37,7 @@ import time
 
 from photon_tpu import obs
 from photon_tpu.game.data import GameData
+from photon_tpu.obs import causal
 from photon_tpu.util import faults
 
 __all__ = [
@@ -153,6 +154,10 @@ class ServeRequest:
     arrival_t: float
     deadline_s: float
     future: ServeFuture
+    #: the request's causal trace (obs/causal.py TraceCtx, or the shared
+    #: null context when tracing is disarmed; None for hand-built
+    #: requests — every consumer guards on it)
+    trace: object = None
 
     def expired(self, now: float | None = None) -> bool:
         now = time.perf_counter() if now is None else now
@@ -216,17 +221,40 @@ class AdmissionQueue:
         arrival in the ``perf_counter`` timebase — open-loop load
         sources stamp it so queueing counts against the deadline (the
         load-harness no-coordinated-omission discipline)."""
-        faults.fault_point("serve.admit")
+        # the causal trace is minted HERE — the chain's first event; a
+        # disarmed plane hands back the shared null context (no records)
+        ctx = causal.mint("serve.request", kind="serve")
+        t_admit = time.perf_counter()
+        try:
+            with ctx.active():
+                faults.fault_point("serve.admit")
+        except BaseException:
+            # the fault instant is already attached; close the trace so
+            # the chaos exemplar shows WHERE the chain was cut
+            ctx.finish("fault")
+            raise
         now = time.perf_counter()
         arrival = now if arrival_t is None else float(arrival_t)
         budget = (
             self.default_deadline_s if deadline_s is None else float(deadline_s)
         )
+
+        def _shed_trace(reason: str) -> None:
+            end = time.perf_counter()
+            ctx.event(
+                "serve.admit", t_admit, end - t_admit,
+                cat="serve", tenant=tenant,
+            )
+            ctx.instant("serve.shed", reason=reason)
+            ctx.finish(f"shed:{reason}", e2e_s=end - arrival)
+
         if budget <= 0:
+            ctx.finish("error")
             raise ValueError(f"deadline budget must be > 0 s, got {budget}")
         if self.max_rows is not None and chunk.num_samples > self.max_rows:
             self.shed_count += 1
             _shed("oversize", tenant)
+            _shed_trace("oversize")
             raise AdmissionRejected(
                 f"request has {chunk.num_samples} rows > the engine's "
                 f"batch_rows={self.max_rows}; split it upstream"
@@ -236,6 +264,7 @@ class AdmissionQueue:
             # enters the queue, the caller learns immediately
             self.shed_count += 1
             _shed("deadline", tenant)
+            _shed_trace("deadline")
             raise DeadlineExceeded(
                 f"request arrived {now - arrival:.3f}s after its scheduled "
                 f"arrival with a {budget:g}s deadline budget"
@@ -244,10 +273,12 @@ class AdmissionQueue:
             if self._closed:
                 self.shed_count += 1
                 _shed("closed", tenant)
+                _shed_trace("closed")
                 raise AdmissionRejected("admission queue is closed")
             if len(self._items) >= self.cap:
                 self.shed_count += 1
                 _shed("queue_full", tenant)
+                _shed_trace("queue_full")
                 raise AdmissionRejected(
                     f"admission queue at cap ({self.cap} requests waiting); "
                     "the device cannot make this deadline"
@@ -260,10 +291,18 @@ class AdmissionQueue:
                 arrival_t=arrival,
                 deadline_s=budget,
                 future=ServeFuture(),
+                trace=ctx,
             )
             self._items.append(req)
             obs.counter("serve.admitted")
             self._not_empty.notify()
+        # the admit slice + the flow START the batch fan-in arrows bind
+        # to (flow ts inside the slice, on this producer thread's track)
+        ctx.event(
+            "serve.admit", t_admit, time.perf_counter() - t_admit,
+            cat="serve", tenant=tenant, seq=req.seq,
+        )
+        ctx.flow("s", t_admit)
         return req.future
 
     def close(self) -> None:
@@ -297,6 +336,14 @@ class AdmissionQueue:
                     req = self._items.popleft()
                     self.shed_count += 1
                     _shed("deadline", req.tenant)
+                    if req.trace is not None:
+                        req.trace.instant(
+                            "serve.shed", reason="deadline",
+                            waited_s=round(now - req.arrival_t, 6),
+                        )
+                        req.trace.finish(
+                            "deadline", e2e_s=now - req.arrival_t
+                        )
                     req.future.set_exception(
                         DeadlineExceeded(
                             f"request {req.seq} waited "
@@ -319,6 +366,14 @@ class AdmissionQueue:
                 if req.expired(now):
                     self.shed_count += 1
                     _shed("deadline", req.tenant)
+                    if req.trace is not None:
+                        req.trace.instant(
+                            "serve.shed", reason="deadline",
+                            waited_s=round(now - req.arrival_t, 6),
+                        )
+                        req.trace.finish(
+                            "deadline", e2e_s=now - req.arrival_t
+                        )
                     req.future.set_exception(
                         DeadlineExceeded(
                             f"request {req.seq} expired in the admission "

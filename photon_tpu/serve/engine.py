@@ -42,7 +42,7 @@ import jax
 import numpy as np
 
 from photon_tpu import obs
-from photon_tpu.obs import slo
+from photon_tpu.obs import causal, slo
 from photon_tpu.game.data import concat_game_data
 from photon_tpu.game.scoring import (
     BATCH_RETRY_POLICY,
@@ -54,9 +54,18 @@ from photon_tpu.util import compile_watch, faults
 from photon_tpu.util.retry import is_transient, retry_call
 from photon_tpu.util.sanitize import sanctioned_transfers
 
-__all__ = ["ServingEngine"]
+__all__ = ["SERVE_STAGES", "ServingEngine"]
 
 logger = logging.getLogger(__name__)
+
+#: the FIXED serving-stage enum: the only keys ``serve.stage_seconds.*``
+#: histograms are ever emitted under, so ``/metrics`` exposition
+#: cardinality is bounded and scrape-stable (a stage outside the enum —
+#: which would be a bug — folds into ``other`` instead of minting a new
+#: family mid-scrape)
+SERVE_STAGES = (
+    "queue", "assemble", "h2d", "dispatch", "pipeline", "readback", "other",
+)
 
 
 class _Pending:
@@ -64,11 +73,11 @@ class _Pending:
 
     __slots__ = (
         "requests", "tenant", "scorer", "dev_scores", "rows",
-        "t_dispatch", "stages", "t_enqueued",
+        "t_dispatch", "stages", "t_enqueued", "group",
     )
 
     def __init__(self, requests, tenant, scorer, dev_scores, rows,
-                 t_dispatch, stages, t_enqueued):
+                 t_dispatch, stages, t_enqueued, group):
         self.requests = requests
         self.tenant = tenant
         self.scorer = scorer
@@ -77,6 +86,7 @@ class _Pending:
         self.t_dispatch = t_dispatch
         self.stages = stages
         self.t_enqueued = t_enqueued
+        self.group = group
 
 
 class ServingEngine:
@@ -107,6 +117,7 @@ class ServingEngine:
     def start(self) -> None:
         if self._thread is not None:
             raise RuntimeError("serving engine already started")
+        causal.ensure_from_env()
         compile_watch.install()
         self._cw_start = compile_watch.snapshot()
         # phl-ok: PHL003 engine-scoped thread; stop() closes the queue, joins, and re-raises loop failures — every owner (CLI finally, tests) calls it
@@ -188,6 +199,12 @@ class ServingEngine:
             in_flight = self.registry.in_flight(tenant)
             t0 = time.perf_counter()
             if self.registry.apply_pending_swap(tenant):
+                # a global lifecycle instant on /trace: chaos runs show
+                # the flip in the same timeline as the victim requests
+                causal.mark(
+                    "serve.swap", tenant=tenant,
+                    in_flight_at_flip=in_flight,
+                )
                 self.last_swap = {
                     "tenant": tenant,
                     "in_flight_at_flip": in_flight,
@@ -198,12 +215,24 @@ class ServingEngine:
     def _resolve_error(self, requests: list[ServeRequest], exc) -> None:
         for req in requests:
             if not req.future.done():
+                tr = req.trace
+                if tr is not None:
+                    tr.instant("serve.error", error=type(exc).__name__)
+                    tr.finish("error")
                 req.future.set_exception(exc)
 
     def _dispatch_batch(self, batch: list[ServeRequest]) -> _Pending | None:
         tenant = batch[0].tenant
         t_pickup = time.perf_counter()
         stages = {"queue": t_pickup - batch[0].arrival_t}
+        # the fan-in point: N request traces join ONE shared batch group
+        # whose slices (assemble/h2d/dispatch/readback) are recorded once
+        # and referenced by every member — Perfetto then draws N flow
+        # arrows converging on the assemble slice
+        group = causal.group(
+            "serve.batch", [r.trace for r in batch],
+            tenant=tenant, requests=len(batch),
+        )
         try:
             scorer = self.registry.acquire(tenant)
         except KeyError as exc:
@@ -227,6 +256,16 @@ class ServingEngine:
                     scorer.batch_rows - packed.num_samples
                 )
             stages["assemble"] = time.perf_counter() - t_pickup
+            group.event(
+                "serve.assemble", t_pickup, stages["assemble"],
+                tenant=tenant, requests=len(batch),
+                rows=packed.num_samples,
+            )
+            for req in batch:
+                if req.trace is not None:
+                    # flow step INTO the batch: ts at the assemble
+                    # slice's start so the arrow binds to it
+                    req.trace.flow("t", t_pickup)
 
             tries = 0
             h2d_acc = [0.0]
@@ -251,16 +290,26 @@ class ServingEngine:
                 return scorer._dispatch(batch_dev, key)
 
             t_dispatch = time.perf_counter()
-            dev_scores = retry_call(
-                run_batch,
-                policy=BATCH_RETRY_POLICY,
-                classify=is_transient,
-                label="serve_batch",
-            )
+            # the group is active for the dispatch window so injected
+            # serve.dispatch faults land as instants in the batch slice
+            with group.active():
+                dev_scores = retry_call(
+                    run_batch,
+                    policy=BATCH_RETRY_POLICY,
+                    classify=is_transient,
+                    label="serve_batch",
+                )
             stages["h2d"] = h2d_acc[0]
             stages["dispatch"] = (
                 time.perf_counter() - t_dispatch
             ) - h2d_acc[0]
+            # contiguous approximation of the measured walls: H2D then
+            # dispatch, back to back from the dispatch stamp
+            group.event("serve.h2d", t_dispatch, stages["h2d"])
+            group.event(
+                "serve.dispatch", t_dispatch + stages["h2d"],
+                stages["dispatch"], tries=tries,
+            )
             if tries > 1:
                 self.stats.batch_retries += tries - 1
                 obs.counter("serve.batch_retries", tries - 1)
@@ -280,6 +329,7 @@ class ServingEngine:
             t_dispatch=t_dispatch,
             stages=stages,
             t_enqueued=time.perf_counter(),
+            group=group,
         )
 
     def _finish(self, pending: _Pending | None) -> None:
@@ -304,6 +354,12 @@ class ServingEngine:
             self.registry.release(pending.tenant, pending.scorer)
             return
         stages["readback"] = time.perf_counter() - t_r0
+        pending.group.event(
+            "serve.pipeline", pending.t_enqueued, stages["pipeline"]
+        )
+        pending.group.event(
+            "serve.readback", t_r0, stages["readback"], rows=pending.rows
+        )
         wall = time.perf_counter() - pending.t_dispatch
         if not self.stats.batch_walls_s and self._cw_start is not None:
             self.stats.compiles_first_batch = compile_watch.delta(
@@ -315,7 +371,10 @@ class ServingEngine:
         obs.histogram("serve.batch_seconds", wall)
         for stage, sec in stages.items():
             self.stats.stage_walls_s.setdefault(stage, []).append(sec)
-            obs.histogram(f"serve.stage_seconds.{stage}", sec)
+            # bounded exposition: only the fixed SERVE_STAGES enum ever
+            # names a serve.stage_seconds.* histogram family
+            key = stage if stage in SERVE_STAGES else "other"
+            obs.histogram(f"serve.stage_seconds.{key}", sec)
         # split the packed scores back out and close each request's
         # latency lifecycle against the armed SLO
         lo = 0
@@ -332,6 +391,14 @@ class ServingEngine:
             obs.counter("serve.rows", n)
             obs.histogram("serve.e2e_seconds", e2e)
             dominant = slo.observe_batch(e2e, stages)
+            tr = req.trace
+            if tr is not None:
+                # flow FINISH inside the read-back slice: the arrow out
+                # of the batch back to this request's causal chain
+                tr.flow("f", t_r0)
+                tr.finish(
+                    "ok" if dominant is None else "deadline", e2e_s=e2e
+                )
             if dominant is not None:
                 self.stats.deadline_violations += 1
                 self.stats.violations_by_stage[dominant] = (

@@ -277,6 +277,15 @@ def fault_point(point: str) -> FaultClause | None:
     if clause is None:
         return None
     logger.warning("fault injected at %s: %s", point, clause.render())
+    try:
+        # chaos visibility: the injected fault lands as an instant in
+        # whatever causal trace is active on this thread (obs/causal.py),
+        # so /trace shows the fault INSIDE the victim's causal chain
+        from photon_tpu.obs import causal
+
+        causal.mark_fault(point, clause.kind)
+    except Exception:  # fault injection must not depend on tracing
+        pass
     if clause.kind == "unavailable":
         raise InjectedFault(
             f"UNAVAILABLE: injected fault at {point!r} "

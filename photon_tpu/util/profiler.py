@@ -1,7 +1,15 @@
 """Device-side tracing: wrap pipeline phases in ``jax.profiler`` annotations
 (the TPU-native counterpart of the reference's Timed + per-phase logging —
 SURVEY.md §5.1). Annotations show up in a captured profiler trace; when no
-trace is being captured they are free."""
+trace is being captured they are free.
+
+Unified with the obs spine: while telemetry is enabled, ``trace_phase``
+IS an ``obs.span`` (cat ``device``), so the phase records on the host
+tracer AND enters a ``TraceAnnotation`` stamped with the span ID — and,
+inside a causal request trace, the trace ID — instead of being a second,
+disconnected tracing mechanism. With telemetry disabled it falls back to
+the bare annotation (still free unless a profiler trace is capturing).
+"""
 from __future__ import annotations
 
 import contextlib
@@ -11,7 +19,14 @@ from typing import Iterator
 @contextlib.contextmanager
 def trace_phase(name: str) -> Iterator[None]:
     """``with trace_phase("fixed-effect solve"): ...`` — emits a named
-    TraceAnnotation visible in TensorBoard/perfetto profiles."""
+    TraceAnnotation visible in TensorBoard/perfetto profiles, joined to
+    the obs span/causal-trace IDs when telemetry is on."""
+    from photon_tpu import obs
+
+    if obs.enabled():
+        with obs.span(name, cat="device"):
+            yield
+        return
     try:
         import jax.profiler
 

@@ -260,6 +260,10 @@ def collect_snapshot() -> dict:
         # machine-speed-dependent slo.* counters; the canonical SLO leg
         # below installs its spec explicitly with synthetic walls
         or k.startswith("PHOTON_SLO_")
+        # causal-trace knobs: an exported PHOTON_TRACE would arm the
+        # trace plane during the canonical legs; the baseline is pinned
+        # with tracing disarmed (the A/B-neutrality test covers armed)
+        or k.startswith("PHOTON_TRACE")
         or k
         in (
             "PHOTON_OBS_MEM",

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""CI trace-schema contract: /trace must serve valid Chrome-trace JSON.
+
+Runs the Poisson load harness briefly with the causal trace plane armed,
+faults armed (a stalled batch forces deadline-violating exemplars plus a
+fault instant inside the victim's chain), fetches ``/trace`` from a live
+:class:`TelemetryServer`, and validates the exported document against
+the golden Chrome-trace schema (``obs.causal.validate_chrome_trace``):
+every event carries its required keys, every flow ``id`` resolves (has
+both its start and finish — no dangling bind IDs), and every flow event
+binds inside a slice on its own track. Also asserts the contract is
+non-vacuous: at least one resolving flow chain, at least one retained
+tail exemplar, and the injected fault visible in the export.
+
+Exit codes: 0 = contract holds; 3 = violation (CI fails the step).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--qps", type=float, default=40.0)
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--batch-rows", type=int, default=64)
+    p.add_argument("--spec", default="p99<=1ms@60s",
+                   help="deliberately tight: violations become exemplars")
+    p.add_argument("--faults", default="scoring.batch@2=stall:0.05",
+                   help="armed so the exported chain shows the injected "
+                        "fault instant")
+    p.add_argument("--seed", type=int, default=7)
+    args = p.parse_args(argv)
+
+    import load_harness
+
+    from photon_tpu import obs
+    from photon_tpu.obs import causal, slo
+    from photon_tpu.obs.http import TelemetryServer
+    from photon_tpu.util import faults
+
+    failures: list[str] = []
+    obs.reset()
+    obs.enable()
+    causal.install(sample_n=1)
+    slo.install(args.spec)
+    if args.faults:
+        faults.install(args.faults)
+    server = TelemetryServer(0)
+    port = server.start()
+    try:
+        scorer, chunks = load_harness.build_workload(
+            num_requests=args.requests,
+            batch_rows=args.batch_rows,
+            d=8,
+            nnz=4,
+            users=16,
+            items=8,
+            mf_factors=2,
+            seed=args.seed,
+        )
+        load_harness.run_leg(scorer, chunks, qps=args.qps, seed=args.seed)
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/trace", timeout=10
+        ) as resp:
+            if resp.status != 200:
+                failures.append(f"/trace returned HTTP {resp.status}")
+            doc = json.loads(resp.read().decode())
+
+        failures.extend(causal.validate_chrome_trace(doc))
+        events = doc.get("traceEvents", [])
+        flow_ids = {
+            e["id"] for e in events if e.get("ph") in ("s", "t", "f")
+        }
+        if not flow_ids:
+            failures.append(
+                "no resolving flow chains in /trace (vacuous contract)"
+            )
+        stats = doc.get("otherData", {}).get("causal_tracing", {})
+        if not stats.get("armed"):
+            failures.append("/trace reports the causal plane disarmed")
+        if args.faults and stats.get("retained_exemplars", 0) < 1:
+            failures.append(
+                "no tail exemplars retained under a violating spec "
+                f"(stats: {stats})"
+            )
+        if args.faults and not any(
+            e.get("name") == "fault.injected" for e in events
+        ):
+            failures.append(
+                "injected fault instant missing from the exported chain"
+            )
+    finally:
+        server.stop()
+        faults.clear()
+        slo.clear()
+        causal.clear()
+        obs.disable()
+        obs.reset()
+
+    if failures:
+        print("TRACE SCHEMA CONTRACT: FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 3
+    print(
+        "TRACE SCHEMA CONTRACT: OK "
+        f"(flows={len(flow_ids)}, exemplars="
+        f"{stats.get('retained_exemplars')}, "
+        f"sampled={stats.get('retained_sampled')})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
